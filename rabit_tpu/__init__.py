@@ -1,0 +1,66 @@
+"""rabit_tpu — a TPU-native fault-tolerant collective framework.
+
+A ground-up re-design of the capabilities of rabit (Reliable Allreduce and
+Broadcast Interface, the fault-tolerant collective library behind distributed
+XGBoost) for TPU hardware:
+
+* the data plane is XLA: collectives lower to ``jax.lax`` ops (``psum``,
+  ``all_gather``, ``ppermute``) over a ``jax.sharding.Mesh`` and ride ICI;
+* the control plane is native C++: a TCP engine (tree + ring collectives,
+  tracker bootstrap) carries recovery traffic, cross-host DCN traffic and
+  serves as the CPU reference implementation;
+* the fault-tolerance protocol (iteration-versioned in-memory checkpoints,
+  consensus-driven replay, live re-admission of restarted workers) layers on
+  top of either engine.
+
+Public API parity with the reference Python binding
+(``/root/reference/python/rabit.py``): ``init``, ``finalize``, ``get_rank``,
+``get_world_size``, ``tracker_print``, ``get_processor_name``, ``broadcast``,
+``allreduce``, ``allgather``, ``load_checkpoint``, ``checkpoint``,
+``lazy_checkpoint``, ``version_number`` and the op enums ``MAX``, ``MIN``,
+``SUM``, ``BITOR``.
+"""
+
+from rabit_tpu.api import (
+    MAX,
+    MIN,
+    SUM,
+    BITOR,
+    init,
+    finalize,
+    get_rank,
+    get_world_size,
+    is_distributed,
+    tracker_print,
+    get_processor_name,
+    broadcast,
+    allreduce,
+    allgather,
+    load_checkpoint,
+    checkpoint,
+    lazy_checkpoint,
+    version_number,
+)
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "MAX",
+    "MIN",
+    "SUM",
+    "BITOR",
+    "init",
+    "finalize",
+    "get_rank",
+    "get_world_size",
+    "is_distributed",
+    "tracker_print",
+    "get_processor_name",
+    "broadcast",
+    "allreduce",
+    "allgather",
+    "load_checkpoint",
+    "checkpoint",
+    "lazy_checkpoint",
+    "version_number",
+]

@@ -1,0 +1,190 @@
+"""Public module-level API.
+
+Parity surface with the reference Python binding
+(``/root/reference/python/rabit.py``) plus ``allgather`` and
+``lazy_checkpoint`` which the reference exposes only at the C++ layer
+(rabit.h:224-232, :311-332).  Objects are pickled for broadcast/checkpoint
+exactly as the reference does (python/rabit.py:171-206, :320-351); allreduce
+takes numpy arrays with the same dtype/op enums.
+
+Caller-site capture: the reference records ``__builtin_FILE()/LINE()`` of the
+caller as the bootstrap-cache key for every collective (rabit.h:29-37).  The
+Python equivalent reads the caller frame via ``sys._getframe`` and passes
+``file:line:function`` down to the engine as ``cache_key``.
+"""
+
+from __future__ import annotations
+
+import pickle
+import sys
+from typing import Any, Callable
+
+import numpy as np
+
+from rabit_tpu.config import Config
+from rabit_tpu.engine import create_engine
+from rabit_tpu.engine.base import MAX, MIN, SUM, BITOR, DTYPE_ENUM, Engine
+
+_engine: Engine | None = None
+
+
+def _caller_key(depth: int = 2) -> str:
+    frame = sys._getframe(depth)
+    return f"{frame.f_code.co_filename}::{frame.f_lineno}::{frame.f_code.co_name}"
+
+
+def _get_engine() -> Engine:
+    """Return the active engine; like the reference (engine.cc:71-82), an
+    uninitialized process gets a solo engine so single-process programs work
+    with zero config."""
+    global _engine
+    if _engine is None:
+        from rabit_tpu.engine.empty import SoloEngine
+
+        _engine = SoloEngine(Config([]))
+        # A zero-config engine is provisional: an explicit init() later may
+        # still replace it (mirrors the reference's uninitialized static
+        # engine, engine.cc:71-82).
+        _engine._provisional = True
+    return _engine
+
+
+def init(args: list[str] | None = None, **overrides: Any) -> None:
+    """Initialize the engine.  ``args`` are ``"key=value"`` strings (defaults
+    to ``sys.argv[1:]``); keyword overrides win over args, args win over env
+    vars (see rabit_tpu.config)."""
+    global _engine
+    if _engine is not None:
+        if getattr(_engine, "_provisional", False):
+            _engine = None
+        else:
+            import warnings
+
+            warnings.warn("rabit_tpu.init ignored: already initialized", stacklevel=2)
+            return
+    if args is None:
+        args = [a for a in sys.argv[1:] if "=" in a]
+    args = [a.decode() if isinstance(a, bytes) else a for a in args]
+    cfg = Config(args, {k: str(v) for k, v in overrides.items()})
+    _engine = create_engine(cfg)
+    _engine.init()
+
+
+def finalize() -> None:
+    """Shut down the engine (reference: RabitFinalize)."""
+    global _engine
+    if _engine is not None:
+        _engine.shutdown()
+        _engine = None
+
+
+def get_rank() -> int:
+    return _get_engine().get_rank()
+
+
+def get_world_size() -> int:
+    return _get_engine().get_world_size()
+
+
+def is_distributed() -> bool:
+    return _get_engine().is_distributed()
+
+
+def tracker_print(msg: str) -> None:
+    """Send a message to the tracker console (reference: TrackerPrint)."""
+    if not isinstance(msg, str):
+        msg = str(msg)
+    _get_engine().tracker_print(msg)
+
+
+def get_processor_name() -> str:
+    return _get_engine().get_host()
+
+
+def broadcast(data: Any, root: int) -> Any:
+    """Broadcast any picklable object from ``root``.  Two-phase
+    length-then-payload, like the reference (python/rabit.py:171-206)."""
+    engine = _get_engine()
+    key = _caller_key()
+    rank = engine.get_rank()
+    payload = None
+    if rank == root:
+        if data is None:
+            raise ValueError("need to pass in data when broadcasting")
+        payload = pickle.dumps(data, protocol=pickle.HIGHEST_PROTOCOL)
+    out = engine.broadcast(payload, root, cache_key=key)
+    return data if rank == root else pickle.loads(out)
+
+
+def allreduce(
+    data: np.ndarray,
+    op: int,
+    prepare_fun: Callable[[np.ndarray], None] | None = None,
+) -> np.ndarray:
+    """Allreduce a numpy array.  ``op`` is one of MAX/MIN/SUM/BITOR.
+    ``prepare_fun(data)`` is called lazily right before the reduction and is
+    skipped when the result is recovered from a peer's replay buffer
+    (reference semantics, python/rabit.py:220-263)."""
+    if not isinstance(data, np.ndarray):
+        raise TypeError("allreduce only takes numpy ndarrays")
+    if data.dtype not in DTYPE_ENUM:
+        raise TypeError(f"dtype {data.dtype} not supported")
+    if op not in (MAX, MIN, SUM, BITOR):
+        raise ValueError(f"unknown reduction op {op}")
+    buf = np.ascontiguousarray(data).reshape(-1).copy()
+    shape = data.shape
+    if prepare_fun is not None:
+        orig_prepare = prepare_fun
+
+        def prepare_fun(buf_view: np.ndarray) -> None:  # type: ignore[misc]
+            orig_prepare(data)
+            buf_view[...] = np.ascontiguousarray(data).reshape(-1)
+
+    out = _get_engine().allreduce(buf, op, prepare_fun=prepare_fun, cache_key=_caller_key())
+    return np.asarray(out).reshape(shape)
+
+
+def allgather(data: np.ndarray) -> np.ndarray:
+    """Gather this rank's array from every rank; returns shape
+    ``(world_size,) + data.shape``."""
+    if not isinstance(data, np.ndarray):
+        raise TypeError("allgather only takes numpy ndarrays")
+    engine = _get_engine()
+    flat = np.ascontiguousarray(data).reshape(-1)
+    out = engine.allgather(flat, cache_key=_caller_key())
+    return np.asarray(out).reshape((engine.get_world_size(),) + data.shape)
+
+
+def load_checkpoint(with_local: bool = False):
+    """Load the latest checkpoint.  Returns ``(version, global_model)`` or
+    ``(version, global_model, local_model)``; version 0 means nothing has
+    been checkpointed yet."""
+    version, gblob, lblob = _get_engine().load_checkpoint()
+    gmodel = pickle.loads(gblob) if version > 0 and gblob is not None else None
+    if with_local:
+        lmodel = pickle.loads(lblob) if version > 0 and lblob is not None else None
+        return version, gmodel, lmodel
+    return version, gmodel
+
+
+def checkpoint(global_model: Any, local_model: Any = None) -> None:
+    """Commit an iteration: pickle and store the models, bump the version.
+    ``local_model`` (rank-specific state) costs ring replication; prefer
+    ``global_model`` (reference notes, python/rabit.py:320-351)."""
+    gblob = pickle.dumps(global_model, protocol=pickle.HIGHEST_PROTOCOL)
+    lblob = None if local_model is None else pickle.dumps(local_model, protocol=pickle.HIGHEST_PROTOCOL)
+    _get_engine().checkpoint(gblob, lblob)
+
+
+def lazy_checkpoint(global_model: Any) -> None:
+    """Checkpoint without eager serialization: the model is only pickled if a
+    failure actually needs the blob.  The caller must not mutate
+    ``global_model`` between checkpoints (reference contract,
+    rabit.h:311-332)."""
+    _get_engine().lazy_checkpoint(
+        lambda: pickle.dumps(global_model, protocol=pickle.HIGHEST_PROTOCOL)
+    )
+
+
+def version_number() -> int:
+    return _get_engine().version_number()

@@ -1,0 +1,129 @@
+"""Layered key=value configuration.
+
+Capability parity with the reference's ``SetParam`` layering (built-in
+defaults <- watched env vars <- argv ``k=v`` overrides, see
+``/root/reference/src/allreduce_base.cc:49-64`` and ``doc/parameters.md``)
+re-expressed as a plain dataclass-free dict with typed accessors instead of
+strcmp chains.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Iterable, Mapping
+
+
+# Environment variables consulted at init time (reference: the ``env_vars``
+# watch list in allreduce_base.cc / allreduce_robust.cc).  Both the legacy
+# DMLC_* spellings and RABIT_TPU_* spellings are honoured; the latter wins.
+_ENV_KEYS = [
+    "DMLC_TRACKER_URI",
+    "DMLC_TRACKER_PORT",
+    "DMLC_TASK_ID",
+    "DMLC_ROLE",
+    "DMLC_NUM_ATTEMPT",
+    "DMLC_WORKER_CONNECT_RETRY",
+    "rabit_global_replica",
+    "rabit_local_replica",
+]
+
+# Mapping from env-var name to canonical config key.
+_ENV_TO_KEY = {
+    "DMLC_TRACKER_URI": "rabit_tracker_uri",
+    "DMLC_TRACKER_PORT": "rabit_tracker_port",
+    "DMLC_TASK_ID": "rabit_task_id",
+    "DMLC_ROLE": "rabit_role",
+    "DMLC_NUM_ATTEMPT": "rabit_num_trial",
+    "DMLC_WORKER_CONNECT_RETRY": "rabit_connect_retry",
+}
+
+_UNIT = {"B": 1, "K": 1 << 10, "M": 1 << 20, "G": 1 << 30}
+
+#: Built-in defaults — the performance envelope knobs of the reference
+#: (allreduce_base.cc:18-46, allreduce_robust.cc:26-40) with identical
+#: semantics and defaults.
+DEFAULTS: dict[str, str] = {
+    "rabit_engine": "auto",           # auto | empty | xla | native | mock
+    "rabit_tracker_uri": "NULL",
+    "rabit_tracker_port": "9091",
+    "rabit_task_id": "NULL",
+    "rabit_num_trial": "0",
+    "rabit_connect_retry": "5",
+    "rabit_reduce_ring_mincount": str(32 << 10),
+    "rabit_tree_reduce_minsize": str(1 << 20),
+    "rabit_reduce_buffer": "256M",
+    "rabit_global_replica": "5",
+    "rabit_local_replica": "2",
+    "rabit_timeout": "0",
+    "rabit_timeout_sec": "1800",
+    "rabit_bootstrap_cache": "0",
+    "rabit_debug": "0",
+    "rabit_enable_tcp_no_delay": "0",
+}
+
+
+def parse_unit(value: str) -> int:
+    """Parse ``"256M"``-style sizes (reference: ParseUnit,
+    allreduce_base.cc:150-170)."""
+    value = value.strip()
+    if value and value[-1].upper() in _UNIT:
+        return int(float(value[:-1]) * _UNIT[value[-1].upper()])
+    return int(value)
+
+
+class Config:
+    """Merged configuration with typed accessors."""
+
+    def __init__(
+        self,
+        args: Iterable[str] | None = None,
+        overrides: Mapping[str, str] | None = None,
+    ):
+        self._cfg = dict(DEFAULTS)
+        # layer 2: environment
+        for env_name in _ENV_KEYS:
+            val = os.environ.get(env_name)
+            if val is not None:
+                self._cfg[_ENV_TO_KEY.get(env_name, env_name)] = val
+        for env_name, val in os.environ.items():
+            if env_name.startswith("RABIT_TPU_"):
+                self._cfg[env_name[len("RABIT_TPU_"):].lower()] = val
+        # layer 3: argv "k=v" pairs
+        for arg in args or []:
+            if "=" in arg:
+                key, val = arg.split("=", 1)
+                self._cfg[key] = val
+        # layer 4: explicit kwargs
+        for key, val in (overrides or {}).items():
+            self._cfg[key] = str(val)
+
+    def get(self, key: str, default: str | None = None) -> str | None:
+        return self._cfg.get(key, default)
+
+    def get_int(self, key: str, default: int = 0) -> int:
+        val = self._cfg.get(key)
+        return default if val is None else int(val)
+
+    def get_size(self, key: str, default: int = 0) -> int:
+        val = self._cfg.get(key)
+        return default if val is None else parse_unit(val)
+
+    def get_bool(self, key: str, default: bool = False) -> bool:
+        val = self._cfg.get(key)
+        return default if val is None else val not in ("0", "false", "False", "")
+
+    def __getitem__(self, key: str) -> str:
+        return self._cfg[key]
+
+    def __contains__(self, key: str) -> bool:
+        return key in self._cfg
+
+    def as_dict(self) -> dict[str, str]:
+        return dict(self._cfg)
+
+    @property
+    def timeout_sec(self) -> int:
+        """Watchdog bound; 0 when the watchdog is disabled."""
+        if not self.get_bool("rabit_timeout"):
+            return 0
+        return self.get_int("rabit_timeout_sec", 1800)

@@ -1,0 +1,38 @@
+"""Engine registry and runtime backend selection.
+
+The reference picks its backend at link time via which library you link
+(``librabit`` vs ``librabit_mock`` vs ``librabit_mpi``, src/engine.cc:19-27);
+here the backend is a config key (``rabit_engine=auto|empty|xla|native|mock``)
+resolved when ``rabit_tpu.init`` runs.
+"""
+
+from __future__ import annotations
+
+from rabit_tpu.config import Config
+from rabit_tpu.engine.base import Engine
+
+
+def create_engine(config: Config) -> Engine:
+    kind = config.get("rabit_engine", "auto")
+    if kind == "auto":
+        # A tracker URI means we are one worker of a launched cluster -> the
+        # native fault-tolerant TCP engine.  Otherwise run solo; the XLA mesh
+        # data plane is reached through rabit_tpu.parallel / models, which are
+        # SPMD and do not need a per-process engine.
+        if config.get("rabit_tracker_uri", "NULL") != "NULL":
+            kind = "native"
+        else:
+            kind = "empty"
+    if kind == "empty":
+        from rabit_tpu.engine.empty import SoloEngine
+
+        return SoloEngine(config)
+    if kind == "xla":
+        from rabit_tpu.engine.xla import XlaEngine
+
+        return XlaEngine(config)
+    if kind in ("native", "mock", "robust", "base"):
+        from rabit_tpu.engine.native import NativeEngine
+
+        return NativeEngine(config, kind)
+    raise ValueError(f"unknown rabit_engine {kind!r}")

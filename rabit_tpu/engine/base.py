@@ -1,0 +1,176 @@
+"""Engine interface — the backend seam.
+
+Capability parity with the reference's ``IEngine`` pure-virtual interface
+(``/root/reference/include/rabit/internal/engine.h:32-209``): every backend
+(solo, XLA/ICI, native TCP, native robust, mock) implements this surface and
+the public API dispatches to a process-wide singleton.  Unlike the reference,
+backend selection happens at *run time* from config (``rabit_engine=...``),
+not at link time.
+"""
+
+from __future__ import annotations
+
+import socket as _socket
+from abc import ABC, abstractmethod
+from typing import Any, Callable
+
+import numpy as np
+
+from rabit_tpu.config import Config
+
+# Reduction op enum — wire/ABI compatible with the reference
+# (python/rabit.py:83-86, engine.h mpi::OpType).
+MAX = 0
+MIN = 1
+SUM = 2
+BITOR = 3
+
+_NUMPY_OPS: dict[int, Callable[[np.ndarray, np.ndarray], np.ndarray]] = {
+    MAX: np.maximum,
+    MIN: np.minimum,
+    SUM: np.add,
+    BITOR: np.bitwise_or,
+}
+
+# dtype enum — ABI compatible with the reference C API
+# (python/rabit.py:209-218, c_api.cc:36-120).
+DTYPE_ENUM = {
+    np.dtype("int8"): 0,
+    np.dtype("uint8"): 1,
+    np.dtype("int32"): 2,
+    np.dtype("uint32"): 3,
+    np.dtype("int64"): 4,
+    np.dtype("uint64"): 5,
+    np.dtype("float32"): 6,
+    np.dtype("float64"): 7,
+}
+
+
+def numpy_reduce(op: int, dst: np.ndarray, src: np.ndarray) -> np.ndarray:
+    """Apply a builtin reduction op elementwise (reference: op::Reducer,
+    rabit-inl.h:95-102)."""
+    if op not in _NUMPY_OPS:
+        raise ValueError(f"unknown reduction op {op}")
+    return _NUMPY_OPS[op](dst, src)
+
+
+class Engine(ABC):
+    """Backend interface.  All buffers at this layer are numpy arrays or raw
+    bytes; the XLA engine additionally accepts jax arrays."""
+
+    def __init__(self, config: Config):
+        self.config = config
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def init(self) -> None:
+        """Connect/bootstrap.  Called once by ``rabit_tpu.init``."""
+
+    def shutdown(self) -> None:
+        """Graceful teardown.  Called by ``rabit_tpu.finalize``."""
+
+    def init_after_exception(self) -> None:
+        """Recover engine state after the caller caught an exception
+        (reference: IEngine::InitAfterException)."""
+        raise RuntimeError(f"{type(self).__name__} cannot recover from exceptions")
+
+    # -- topology ----------------------------------------------------------
+
+    @abstractmethod
+    def get_rank(self) -> int: ...
+
+    @abstractmethod
+    def get_world_size(self) -> int: ...
+
+    def is_distributed(self) -> bool:
+        return self.get_world_size() > 1
+
+    def get_host(self) -> str:
+        return _socket.gethostname()
+
+    def get_ring_prev_rank(self) -> int:
+        """Rank of the ring predecessor (reference: GetRingPrevRank)."""
+        world = self.get_world_size()
+        return (self.get_rank() + world - 1) % world
+
+    # -- collectives -------------------------------------------------------
+
+    @abstractmethod
+    def allreduce(
+        self,
+        data: np.ndarray,
+        op: int,
+        prepare_fun: Callable[[np.ndarray], None] | None = None,
+        cache_key: str | None = None,
+    ) -> np.ndarray:
+        """In-place-semantics allreduce: returns the reduced array (same
+        shape/dtype as ``data``).  ``prepare_fun`` is the lazy initializer:
+        it must be invoked on ``data`` right before the reduction unless the
+        result is served from recovery/replay (reference semantics,
+        rabit.h:182-206)."""
+
+    @abstractmethod
+    def broadcast(self, data: bytes | None, root: int, cache_key: str | None = None) -> bytes:
+        """Broadcast a byte string from ``root`` to everyone."""
+
+    @abstractmethod
+    def allgather(
+        self,
+        data: np.ndarray,
+        cache_key: str | None = None,
+    ) -> np.ndarray:
+        """Gather equal-sized per-rank slices into one array: input is this
+        rank's slice, output is the concatenation over ranks (built on the
+        reference's slice-addressed ring allgather, engine.h:56-79)."""
+
+    # -- custom reduction --------------------------------------------------
+
+    def allreduce_fn(
+        self,
+        data: np.ndarray,
+        reduce_fn: Callable[[np.ndarray, np.ndarray], np.ndarray],
+        prepare_fun: Callable[[np.ndarray], None] | None = None,
+        cache_key: str | None = None,
+    ) -> np.ndarray:
+        """Allreduce with a user reduction function (reference: Reducer /
+        SerializeReducer, rabit.h:352-456).  Default implementation: gather
+        all slices and fold locally — backends may override with a tree
+        reduction of serialized states."""
+        if prepare_fun is not None:
+            prepare_fun(data)
+        flat = np.ascontiguousarray(data).reshape(-1)
+        gathered = self.allgather(flat, cache_key=cache_key)
+        world = self.get_world_size()
+        parts = gathered.reshape(world, *data.shape)
+        acc = np.array(parts[0], copy=True)
+        for i in range(1, world):
+            acc = reduce_fn(acc, parts[i])
+        return acc.astype(data.dtype).reshape(data.shape)
+
+    # -- checkpoint / recovery --------------------------------------------
+
+    @abstractmethod
+    def load_checkpoint(self) -> tuple[int, bytes | None, bytes | None]:
+        """Return (version, global_blob, local_blob); version 0 means no
+        checkpoint exists yet."""
+
+    @abstractmethod
+    def checkpoint(self, global_blob: bytes, local_blob: bytes | None = None) -> None:
+        """Commit an iteration: store blobs, bump version."""
+
+    def lazy_checkpoint(self, get_global_blob: Callable[[], bytes]) -> None:
+        """Defer serialization until a failure actually needs the blob
+        (reference: LazyCheckPoint, rabit.h:311-332).  Default: eager."""
+        self.checkpoint(get_global_blob())
+
+    @abstractmethod
+    def version_number(self) -> int: ...
+
+    # -- observability -----------------------------------------------------
+
+    def tracker_print(self, msg: str) -> None:
+        print(msg, end="" if msg.endswith("\n") else "\n", flush=True)
+
+
+class ShutdownSignal(Exception):
+    """Raised internally when the tracker orders shutdown."""

@@ -1,0 +1,25 @@
+"""Test configuration.
+
+Sharding/mesh tests run on a virtual 8-device CPU platform — the env vars
+must be set before jax is first imported anywhere in the test process.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _fresh_engine():
+    """Each test starts with an uninitialized engine singleton."""
+    yield
+    import rabit_tpu
+
+    rabit_tpu.api._engine = None
