@@ -131,7 +131,7 @@ def allreduce(
         raise TypeError(f"dtype {data.dtype} not supported")
     if op not in (MAX, MIN, SUM, BITOR):
         raise ValueError(f"unknown reduction op {op}")
-    buf = np.ascontiguousarray(data).reshape(-1).copy()
+    buf = data.flatten()  # always a fresh 1-D C-order copy
     shape = data.shape
     if prepare_fun is not None:
         orig_prepare = prepare_fun

@@ -110,7 +110,9 @@ class Config:
 
     def get_bool(self, key: str, default: bool = False) -> bool:
         val = self._cfg.get(key)
-        return default if val is None else val not in ("0", "false", "False", "")
+        if val is None:
+            return default
+        return val.strip().lower() not in ("0", "false", "no", "off", "")
 
     def __getitem__(self, key: str) -> str:
         return self._cfg[key]

@@ -32,7 +32,12 @@ def create_engine(config: Config) -> Engine:
 
         return XlaEngine(config)
     if kind in ("native", "mock", "robust", "base"):
-        from rabit_tpu.engine.native import NativeEngine
+        try:
+            from rabit_tpu.engine.native import NativeEngine
+        except ModuleNotFoundError as exc:
+            raise RuntimeError(
+                "the native TCP engine is not available in this build"
+            ) from exc
 
         return NativeEngine(config, kind)
     raise ValueError(f"unknown rabit_engine {kind!r}")

@@ -67,9 +67,12 @@ class SoloEngine(Engine):
 
     def lazy_checkpoint(self, get_global_blob: Callable[[], bytes]) -> None:
         # Solo mode has no peers to recover from; keep the thunk, bump the
-        # version, and only serialize if someone later loads.
+        # version, and only serialize if someone later loads.  Lazy
+        # checkpoints carry no local model (reference contract: LazyCheckPoint
+        # takes only the global model, rabit.h:311-332).
         self._lazy_thunk = get_global_blob
         self._global_blob = None
+        self._local_blob = None
         self._version += 1
 
     def version_number(self) -> int:

@@ -1,0 +1,69 @@
+"""LazyAllreduce — host-side fusion of small reductions.
+
+The north-star capability (reference: guide/lazy_allreduce.cc and the lazy
+``prepare_fun`` contract, rabit.h:182-206): instead of paying one collective
+per small buffer, pending reductions are queued and flushed as ONE
+allreduce per (dtype, op) group.  Works against any engine — the XLA engine
+turns the flush into a single fused device collective; the native engine
+into one TCP tree/ring pass.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import numpy as np
+
+from rabit_tpu.engine.base import SUM
+
+
+class _Handle:
+    """Future-like handle for one queued buffer."""
+
+    __slots__ = ("_result",)
+
+    def __init__(self) -> None:
+        self._result: np.ndarray | None = None
+
+    def get(self) -> np.ndarray:
+        if self._result is None:
+            raise RuntimeError("LazyAllreduce handle read before flush()")
+        return self._result
+
+
+class LazyAllreduce:
+    """Queue buffers with ``add``; ``flush`` runs one fused allreduce per
+    (dtype, op) group and resolves every handle."""
+
+    def __init__(self, allreduce_fn: Callable[..., np.ndarray] | None = None):
+        if allreduce_fn is None:
+            from rabit_tpu import api
+
+            allreduce_fn = lambda buf, op: api._get_engine().allreduce(buf, op)
+        self._allreduce = allreduce_fn
+        self._pending: list[tuple[np.ndarray, int, _Handle]] = []
+
+    def add(self, data: np.ndarray, op: int = SUM) -> _Handle:
+        arr = np.ascontiguousarray(data)
+        handle = _Handle()
+        self._pending.append((arr, op, handle))
+        return handle
+
+    def __len__(self) -> int:
+        return len(self._pending)
+
+    def flush(self) -> None:
+        groups: dict[tuple[Any, int], list[tuple[np.ndarray, _Handle]]] = {}
+        for arr, op, handle in self._pending:
+            groups.setdefault((arr.dtype, op), []).append((arr, handle))
+        self._pending.clear()
+        for (dtype, op), items in groups.items():
+            flats = [a.reshape(-1) for a, _ in items]
+            fused = np.concatenate(flats) if len(flats) > 1 else flats[0].copy()
+            reduced = np.asarray(self._allreduce(fused, op))
+            offset = 0
+            for arr, handle in items:
+                handle._result = (
+                    reduced[offset : offset + arr.size].reshape(arr.shape).astype(dtype)
+                )
+                offset += arr.size

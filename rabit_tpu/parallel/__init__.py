@@ -1,0 +1,40 @@
+"""TPU parallelism: meshes, in-graph collectives, sequence-parallel rings."""
+
+from rabit_tpu.parallel.mesh import (
+    create_mesh,
+    ring_perm,
+    replicated,
+    sharded_along,
+    snake_order,
+)
+from rabit_tpu.parallel.collectives import (
+    allreduce,
+    broadcast,
+    allgather,
+    reduce_scatter,
+    ring_shift,
+    ring_reduce_scatter,
+    ring_allgather,
+    ring_allreduce,
+    fused_allreduce,
+)
+from rabit_tpu.parallel.ring import ring_attention, reference_attention
+
+__all__ = [
+    "create_mesh",
+    "ring_perm",
+    "replicated",
+    "sharded_along",
+    "snake_order",
+    "allreduce",
+    "broadcast",
+    "allgather",
+    "reduce_scatter",
+    "ring_shift",
+    "ring_reduce_scatter",
+    "ring_allgather",
+    "ring_allreduce",
+    "fused_allreduce",
+    "ring_attention",
+    "reference_attention",
+]

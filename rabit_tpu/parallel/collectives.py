@@ -1,0 +1,163 @@
+"""In-graph collectives — the TPU data plane.
+
+These are the XLA-native re-expression of the reference's hand-rolled
+poll-loop collectives: where rabit selects tree vs ring by payload size and
+pipelines 1MB chunks over TCP (TryAllreduce dispatch,
+/root/reference/src/allreduce_base.cc:454-464), here the *compiler* owns
+scheduling — ``psum``/``all_gather``/``psum_scatter`` lower to fused ICI
+collectives.  The explicit ring algorithms (``ring_reduce_scatter``,
+``ring_allgather``, ``ring_allreduce``) express the same
+bandwidth-optimal chunked rings as the reference
+(TryReduceScatterRing :857-946, TryAllgatherRing :779-843) as ``ppermute``
+chains — each hop a single ICI neighbor transfer — and double as the
+communication skeleton for sequence parallelism (see parallel.ring).
+
+All functions take an ``axis_name`` and must run inside ``shard_map`` /
+``pjit`` over a Mesh.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from rabit_tpu.engine.base import BITOR, MAX, MIN, SUM
+
+Array = jax.Array
+
+
+def allreduce(x: Array, axis_name: str, op: int = SUM) -> Array:
+    """Allreduce with a rabit op enum (MAX/MIN/SUM/BITOR)."""
+    if op == SUM:
+        return lax.psum(x, axis_name)
+    if op == MAX:
+        return lax.pmax(x, axis_name)
+    if op == MIN:
+        return lax.pmin(x, axis_name)
+    if op == BITOR:
+        # No bitwise-or collective primitive: decompose into bit planes and
+        # OR them with ONE fused pmax (a | b == max(a,b) per bit).  BITOR
+        # buffers are tiny (consensus flag words, reference ActionSummary
+        # allreduce_robust.h:298-315) so the nbits× inflation is free.
+        nbits = x.dtype.itemsize * 8
+        utype = jnp.dtype(f"uint{nbits}")
+        ux = x.astype(utype)
+        shifts = jnp.arange(nbits, dtype=utype).reshape((nbits,) + (1,) * x.ndim)
+        planes = (ux[None] >> shifts) & utype.type(1)
+        ored = lax.pmax(planes, axis_name)
+        return (ored << shifts).sum(axis=0, dtype=utype).astype(x.dtype)
+    raise ValueError(f"unknown reduction op {op}")
+
+
+def broadcast(x: Array, axis_name: str, root: int = 0) -> Array:
+    """Broadcast ``x`` from mesh position ``root`` (reference: TryBroadcast,
+    allreduce_base.cc:677-765 — here a masked psum XLA turns into an
+    all-reduce-from-one)."""
+    idx = lax.axis_index(axis_name)
+    contrib = jnp.where(idx == root, x, jnp.zeros_like(x))
+    if jnp.issubdtype(x.dtype, jnp.integer) or x.dtype == jnp.bool_:
+        return lax.psum(contrib.astype(jnp.int32), axis_name).astype(x.dtype)
+    return lax.psum(contrib, axis_name)
+
+
+def allgather(x: Array, axis_name: str, axis: int = 0, tiled: bool = False) -> Array:
+    return lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def reduce_scatter(x: Array, axis_name: str, axis: int = 0) -> Array:
+    """Sum-reduce then scatter slices along ``axis`` (tiled)."""
+    return lax.psum_scatter(x, axis_name, scatter_dimension=axis, tiled=True)
+
+
+def ring_shift(x: Any, axis_name: str, shift: int = 1) -> Any:
+    """Send this shard to the ring successor ``shift`` positions away.
+    Works on pytrees.  The generic streaming primitive (reference:
+    RingPassing, allreduce_robust.cc:1529-1587)."""
+    n = lax.axis_size(axis_name)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return lax.ppermute(x, axis_name, perm)
+
+
+def ring_reduce_scatter(x: Array, axis_name: str) -> Array:
+    """Explicit n-1-step ring reduce-scatter.
+
+    ``x``'s leading dim must be divisible by the axis size; rank i ends up
+    holding chunk i of the fully reduced sum.  Mirrors the reference's
+    pipelined ring (TryReduceScatterRing): at step s each rank forwards the
+    partial sum of chunk (i-1-s) mod n to its successor and folds its own
+    copy into the chunk arriving from its predecessor.
+    """
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    chunks = x.reshape((n, x.shape[0] // n) + x.shape[1:])
+
+    def body(s, send):
+        recv = lax.ppermute(send, axis_name, perm)
+        mine = lax.dynamic_index_in_dim(chunks, (idx - 2 - s) % n, keepdims=False)
+        return recv + mine
+
+    init = lax.dynamic_index_in_dim(chunks, (idx - 1) % n, keepdims=False)
+    return lax.fori_loop(0, n - 1, body, init)
+
+
+def ring_allgather(x: Array, axis_name: str) -> Array:
+    """Explicit n-1-step ring allgather: input is this rank's slice, output
+    is ``(n,) + x.shape`` with slice j from rank j (reference:
+    TryAllgatherRing — slice-addressed so sequence-sharded workloads
+    compose, engine.h:56-79)."""
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    out = jnp.zeros((n,) + x.shape, x.dtype)
+    out = lax.dynamic_update_index_in_dim(out, x, idx, 0)
+
+    def body(s, carry):
+        out, cur = carry
+        cur = lax.ppermute(cur, axis_name, perm)
+        # After s+1 hops the block in hand originated s+1 ring positions back.
+        out = lax.dynamic_update_index_in_dim(out, cur, (idx - s - 1) % n, 0)
+        return out, cur
+
+    out, _ = lax.fori_loop(0, n - 1, body, (out, x))
+    return out
+
+
+def ring_allreduce(x: Array, axis_name: str) -> Array:
+    """Bandwidth-optimal ring allreduce = ring reduce-scatter + ring
+    allgather (reference: TryAllreduceRing, allreduce_base.cc:958-977).
+    Leading dim must be divisible by the axis size."""
+    n = lax.axis_size(axis_name)
+    owned = ring_reduce_scatter(x, axis_name)
+    gathered = ring_allgather(owned, axis_name)
+    return gathered.reshape(x.shape)
+
+
+def fused_allreduce(tree: Any, axis_name: str, op: int = SUM) -> Any:
+    """Allreduce a whole pytree as ONE collective per dtype group.
+
+    The in-graph LazyAllreduce: leaves are raveled, concatenated by dtype,
+    reduced with a single psum/pmax/pmin, and split back — guaranteeing one
+    fused XLA collective where the reference fuses small reductions lazily
+    (lazy_allreduce.cc / north-star LazyAllreduce).
+    """
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    groups: dict[Any, list[int]] = {}
+    for i, leaf in enumerate(leaves):
+        groups.setdefault(jnp.asarray(leaf).dtype, []).append(i)
+    out_leaves: list[Any] = [None] * len(leaves)
+    for dtype, idxs in groups.items():
+        flats = [jnp.ravel(leaves[i]) for i in idxs]
+        sizes = [f.size for f in flats]
+        fused = allreduce(jnp.concatenate(flats), axis_name, op)
+        offset = 0
+        for i, size in zip(idxs, sizes):
+            out_leaves[i] = lax.dynamic_slice_in_dim(fused, offset, size).reshape(
+                jnp.shape(leaves[i])
+            )
+            offset += size
+    return jax.tree_util.tree_unflatten(treedef, out_leaves)

@@ -1,0 +1,77 @@
+"""Device-mesh construction with TPU topology awareness.
+
+The reference's tracker assigns each worker a position in a reduction tree
+and a ring laid over TCP links (ReConnectLinks,
+/root/reference/src/allreduce_base.cc:263-438).  On TPU the equivalent is
+laying the mesh ring along ICI neighbors: we read each device's torus
+coordinates and snake through the torus so that consecutive mesh positions
+are physical neighbors, which turns every ``ppermute`` ring shift into a
+single-hop ICI transfer.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+
+def snake_order(devices: Sequence) -> list:
+    """Order devices so consecutive entries are torus neighbors.
+
+    Devices with ``coords`` (TPU) are sorted boustrophedon: even rows
+    left-to-right, odd rows right-to-left, recursively over the outer
+    dimensions — a Hamiltonian path on a grid, so each hop is one ICI link.
+    Devices without coords (CPU/virtual) keep id order.
+    """
+    devs = list(devices)
+    if not devs or getattr(devs[0], "coords", None) is None:
+        return sorted(devs, key=lambda d: d.id)
+
+    def key(d):
+        # coords are (x, y, z); snake along x within y rows, along y within
+        # z planes.
+        x, y, z = (list(d.coords) + [0, 0, 0])[:3]
+        sx = x if (y + z) % 2 == 0 else -x
+        sy = y if z % 2 == 0 else -y
+        return (z, sy, sx)
+
+    return sorted(devs, key=key)
+
+
+def create_mesh(
+    axis_names: Sequence[str] = ("dp",),
+    shape: Sequence[int] | None = None,
+    devices: Sequence | None = None,
+) -> Mesh:
+    """Build a Mesh over ``devices`` (default: all), snake-ordered for ICI.
+
+    ``shape`` defaults to all devices on the first axis and 1 on the rest.
+    """
+    devs = snake_order(devices if devices is not None else jax.devices())
+    if shape is None:
+        shape = [len(devs)] + [1] * (len(axis_names) - 1)
+    shape = tuple(shape)
+    n = int(np.prod(shape))
+    if n > len(devs):
+        raise ValueError(f"mesh shape {shape} needs {n} devices, have {len(devs)}")
+    grid = np.array(devs[:n], dtype=object).reshape(shape)
+    return Mesh(grid, tuple(axis_names))
+
+
+def ring_perm(n: int, shift: int = 1) -> list[tuple[int, int]]:
+    """ppermute permutation sending mesh position i to i+shift (mod n)."""
+    return [(i, (i + shift) % n) for i in range(n)]
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def sharded_along(mesh: Mesh, axis_name: str, ndim: int = 1, dim: int = 0) -> NamedSharding:
+    """NamedSharding partitioning array dimension ``dim`` over ``axis_name``."""
+    spec = [None] * ndim
+    spec[dim] = axis_name
+    return NamedSharding(mesh, PartitionSpec(*spec))

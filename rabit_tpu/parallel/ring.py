@@ -1,0 +1,101 @@
+"""Ring attention — sequence/context parallelism over the ICI ring.
+
+The reference predates LLMs but its ring machinery (slice-addressed ring
+allgather + generic ring streaming, /root/reference/src/allreduce_base.cc:779-843
+and allreduce_robust.cc:1529-1587) is exactly the communication skeleton of
+ring attention.  Here that skeleton is first-class: the sequence is sharded
+over a mesh axis, each device owns one block of Q/K/V, and K/V blocks rotate
+around the ring with ``ppermute`` while a numerically stable online softmax
+accumulates — so arbitrarily long contexts run with per-device memory
+O(seq/n) and every hop is one ICI neighbor transfer overlapping compute.
+
+Shapes are per-device blocks: q, k, v are ``[block, heads, dim]``; the
+global sequence is ``n_devices * block`` laid out so mesh position i holds
+block i.  Run inside ``shard_map``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_NEG_INF = -1e30
+
+
+def _block_attend(q, k, v, scale, q_pos, k_pos, causal):
+    """Scores of q block against one k/v block with optional causal mask.
+    Returns (unnormalized out, row max, row sumexp)."""
+    s = jnp.einsum("qhd,khd->hqk", q, k) * scale
+    if causal:
+        mask = k_pos[None, None, :] <= q_pos[None, :, None]
+        s = jnp.where(mask, s, _NEG_INF)
+    m = jnp.max(s, axis=-1)                      # [h, q]
+    p = jnp.exp(s - m[..., None])                # [h, q, k]
+    p = jnp.where(m[..., None] <= _NEG_INF / 2, 0.0, p)
+    o = jnp.einsum("hqk,khd->qhd", p, v)         # [q, h, d]
+    l = jnp.sum(p, axis=-1)                      # [h, q]
+    return o, m, l
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str,
+    causal: bool = False,
+) -> jax.Array:
+    """Blockwise ring attention over sequence shards.
+
+    Each of the n mesh positions holds contiguous sequence block i; K/V
+    rotate n times around the ring; the online-softmax accumulator merges
+    each visiting block.  Output is this device's attention block
+    ``[block, heads, dim]``.
+    """
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    block, heads, dim = q.shape
+    scale = 1.0 / (dim ** 0.5)
+    q_pos = idx * block + jnp.arange(block)
+
+    def step(carry, s):
+        o, m, l, kb, vb = carry
+        # The k/v block in hand after s hops originated s positions back.
+        src = (idx - s) % n
+        k_pos = src * block + jnp.arange(block)
+        bo, bm, bl = _block_attend(q, kb, vb, scale, q_pos, k_pos, causal)
+        m_new = jnp.maximum(m, bm)
+        alpha = jnp.exp(m - m_new)               # rescale old accumulator
+        beta = jnp.exp(bm - m_new)               # rescale new block
+        alpha = jnp.where(m <= _NEG_INF / 2, 0.0, alpha)
+        beta = jnp.where(bm <= _NEG_INF / 2, 0.0, beta)
+        o = o * alpha.T[..., None] + bo * beta.T[..., None]
+        l = l * alpha + bl * beta
+        # Rotate K/V to the ring successor — one ICI hop, overlapped by XLA
+        # with the next block's compute.
+        kb, vb = lax.ppermute((kb, vb), axis_name, perm)
+        return (o, m_new, l, kb, vb), None
+
+    o0 = jnp.zeros_like(q, dtype=jnp.float32)  # inherits q's vma
+    m0 = lax.pvary(jnp.full((heads, block), _NEG_INF, dtype=jnp.float32), axis_name)
+    l0 = lax.pvary(jnp.zeros((heads, block), dtype=jnp.float32), axis_name)
+    (o, m, l, _, _), _ = lax.scan(
+        step, (o0, m0, l0, k.astype(jnp.float32), v.astype(jnp.float32)), jnp.arange(n)
+    )
+    l = jnp.where(l == 0.0, 1.0, l)
+    return (o / l.T[..., None]).astype(q.dtype)
+
+
+def reference_attention(q, k, v, causal: bool = False) -> jax.Array:
+    """Unsharded full attention, for testing ring_attention.  Shapes
+    ``[seq, heads, dim]``."""
+    seq, heads, dim = q.shape
+    s = jnp.einsum("qhd,khd->hqk", q, k) / (dim ** 0.5)
+    if causal:
+        mask = jnp.arange(seq)[None, :] <= jnp.arange(seq)[:, None]
+        s = jnp.where(mask[None], s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("hqk,khd->qhd", p, v).astype(q.dtype)

@@ -1,17 +1,22 @@
 """Test configuration.
 
-Sharding/mesh tests run on a virtual 8-device CPU platform — the env vars
-must be set before jax is first imported anywhere in the test process.
+Sharding/mesh tests run on a virtual 8-device CPU platform.  The container's
+sitecustomize force-registers the TPU ('axon') backend via jax config — env
+vars alone don't stick — so we must override the config knob itself before
+the backend initializes, and XLA_FLAGS before first device query.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

@@ -1,0 +1,197 @@
+"""Mesh collective tests on the virtual 8-device CPU mesh — the explicit
+ring algorithms must agree with XLA's built-in collectives, and ring
+attention with full attention."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import rabit_tpu as rt
+from rabit_tpu import parallel as rp
+
+N = 8
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return rp.create_mesh(("dp",))
+
+
+def shmap(f, mesh, in_specs, out_specs):
+    return jax.shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+
+
+def test_create_mesh_shape(mesh):
+    assert mesh.devices.shape == (N,)
+    assert mesh.axis_names == ("dp",)
+
+
+def test_create_mesh_2d():
+    m = rp.create_mesh(("dp", "fp"), shape=(4, 2))
+    assert m.devices.shape == (4, 2)
+
+
+def test_snake_order_is_neighbor_path():
+    class FakeDev:
+        def __init__(self, id, coords):
+            self.id, self.coords = id, coords
+
+    # 4x4 grid, scrambled input order
+    devs = [FakeDev(y * 4 + x, (x, y, 0)) for y in range(4) for x in range(4)]
+    rng = np.random.RandomState(0)
+    rng.shuffle(devs)
+    ordered = rp.snake_order(devs)
+    assert len(ordered) == 16
+    for a, b in zip(ordered, ordered[1:]):
+        dist = sum(abs(p - q) for p, q in zip(a.coords, b.coords))
+        assert dist == 1, f"non-neighbor hop {a.coords}->{b.coords}"
+
+
+def test_allreduce_ops(mesh):
+    x = np.arange(N, dtype=np.float32)
+    for op, expect in [
+        (rt.SUM, np.full(1, x.sum())),
+        (rt.MAX, np.full(1, x.max())),
+        (rt.MIN, np.full(1, x.min())),
+    ]:
+        f = shmap(lambda v, op=op: rp.allreduce(v, "dp", op), mesh, P("dp"), P())
+        np.testing.assert_allclose(np.asarray(f(x)), expect)
+
+
+def test_allreduce_bitor(mesh):
+    x = (1 << np.arange(N, dtype=np.uint32))
+    f = shmap(lambda v: rp.allreduce(v, "dp", rt.BITOR), mesh, P("dp"), P())
+    assert np.asarray(f(x))[0] == 0xFF
+
+
+def test_broadcast_from_root(mesh):
+    x = np.arange(N, dtype=np.float32) * 10
+    for root in [0, 3, 7]:
+        f = shmap(lambda v, r=root: rp.broadcast(v, "dp", r), mesh, P("dp"), P("dp"))
+        out = np.asarray(f(x))
+        np.testing.assert_allclose(out, np.full(N, x[root]))
+
+
+def test_broadcast_int(mesh):
+    x = np.arange(N, dtype=np.int32)
+    f = shmap(lambda v: rp.broadcast(v, "dp", 5), mesh, P("dp"), P("dp"))
+    np.testing.assert_array_equal(np.asarray(f(x)), np.full(N, 5, np.int32))
+
+
+def test_reduce_scatter_matches_manual(mesh):
+    x = np.random.RandomState(1).randn(N, N * 3).astype(np.float32)
+    f = shmap(lambda v: rp.reduce_scatter(v[0], "dp"), mesh, P("dp", None), P("dp"))
+    out = np.asarray(f(x)).reshape(-1)
+    np.testing.assert_allclose(out, x.sum(0), rtol=1e-5)
+
+
+def test_ring_shift(mesh):
+    x = np.arange(N, dtype=np.int32)
+    f = shmap(lambda v: rp.ring_shift(v, "dp", 1), mesh, P("dp"), P("dp"))
+    np.testing.assert_array_equal(np.asarray(f(x)), np.roll(x, 1))
+
+
+def test_ring_reduce_scatter(mesh):
+    # Each device holds a [N*2] row; rank i must end with chunk i of the sum.
+    rng = np.random.RandomState(2)
+    x = rng.randn(N, N * 2).astype(np.float32)
+    f = shmap(lambda v: rp.ring_reduce_scatter(v[0], "dp"), mesh, P("dp", None), P("dp"))
+    out = np.asarray(f(x)).reshape(-1)
+    np.testing.assert_allclose(out, x.sum(0), rtol=1e-4)
+
+
+def test_ring_allgather(mesh):
+    x = np.arange(N * 3, dtype=np.float32).reshape(N, 3)
+    f = shmap(lambda v: rp.ring_allgather(v[0], "dp"), mesh, P("dp", None), P("dp", None))
+    out = np.asarray(f(x)).reshape(N, N, 3)
+    for i in range(N):
+        np.testing.assert_array_equal(out[i], x)
+
+
+def test_ring_allreduce_matches_psum(mesh):
+    rng = np.random.RandomState(3)
+    x = rng.randn(N, N * 4).astype(np.float32)
+    ring = shmap(
+        lambda v: rp.ring_allreduce(v[0], "dp")[None], mesh, P("dp", None), P("dp", None)
+    )
+    out = np.asarray(ring(x))  # [N, N*4]: every device's copy of the result
+    for i in range(N):
+        np.testing.assert_allclose(out[i], x.sum(0), rtol=1e-4)
+
+
+def test_fused_allreduce_pytree(mesh):
+    rng = np.random.RandomState(4)
+    tree = {
+        "w": rng.randn(N, 4, 3).astype(np.float32),
+        "b": rng.randn(N, 5).astype(np.float32),
+        "steps": np.tile(np.arange(N, dtype=np.int32)[:, None], (1, 2)),
+    }
+    f = shmap(
+        lambda t: rp.fused_allreduce(t, "dp", rt.SUM),
+        mesh,
+        P("dp"),
+        P(),
+    )
+    out = jax.tree.map(np.asarray, f(tree))
+    np.testing.assert_allclose(out["w"], tree["w"].sum(0)[None], rtol=1e-5)
+    np.testing.assert_allclose(out["b"], tree["b"].sum(0)[None], rtol=1e-5)
+    np.testing.assert_array_equal(out["steps"], tree["steps"].sum(0)[None])
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_matches_reference(mesh, causal):
+    rng = np.random.RandomState(5)
+    seq, heads, dim = N * 4, 2, 8
+    q = rng.randn(seq, heads, dim).astype(np.float32)
+    k = rng.randn(seq, heads, dim).astype(np.float32)
+    v = rng.randn(seq, heads, dim).astype(np.float32)
+
+    f = shmap(
+        lambda q, k, v: rp.ring_attention(q, k, v, "dp", causal=causal),
+        mesh,
+        (P("dp", None, None),) * 3,
+        P("dp", None, None),
+    )
+    out = np.asarray(f(q, k, v))
+    expect = np.asarray(rp.reference_attention(q, k, v, causal=causal))
+    np.testing.assert_allclose(out, expect, rtol=2e-4, atol=2e-5)
+
+
+def test_lazy_allreduce_fusion_solo():
+    from rabit_tpu.fusion import LazyAllreduce
+
+    calls = []
+
+    def fake_allreduce(buf, op):
+        calls.append((buf.size, op))
+        return buf * 2
+
+    lazy = LazyAllreduce(fake_allreduce)
+    h1 = lazy.add(np.ones(3, np.float32))
+    h2 = lazy.add(np.full((2, 2), 2.0, np.float32))
+    h3 = lazy.add(np.arange(4, dtype=np.int32), rt.MAX)
+    assert len(lazy) == 3
+    with pytest.raises(RuntimeError):
+        h1.get()
+    lazy.flush()
+    # one fused call for the two f32 SUM buffers, one for the int MAX buffer
+    assert sorted(calls) == [(4, rt.MAX), (7, rt.SUM)]
+    np.testing.assert_allclose(h1.get(), np.full(3, 2.0))
+    np.testing.assert_allclose(h2.get(), np.full((2, 2), 4.0))
+    np.testing.assert_array_equal(h3.get(), np.arange(4) * 2)
+    assert len(lazy) == 0
+
+
+def test_xla_engine_solo_paths():
+    rt.init(["rabit_engine=xla"])
+    assert rt.get_rank() == 0 and rt.get_world_size() == 1
+    x = np.arange(4, dtype=np.float64)
+    np.testing.assert_array_equal(rt.allreduce(x, rt.SUM), x)
+    assert rt.broadcast([1, 2], 0) == [1, 2]
+    rt.checkpoint({"m": 1})
+    assert rt.load_checkpoint() == (1, {"m": 1})
+    rt.lazy_checkpoint({"m": 2})
+    assert rt.load_checkpoint() == (2, {"m": 2})
+    rt.finalize()
