@@ -65,9 +65,12 @@ class XlaEngine(Engine):
         is_root = self.get_rank() == root
         # Two-phase length-then-payload, like the reference binding
         # (python/rabit.py:171-206): all processes must present equal shapes.
-        length = np.array([len(data) if is_root and data is not None else 0], np.int64)
+        # Length rides as (hi, lo) int32 halves — JAX downcasts int64 arrays
+        # under its default 32-bit config, which would wrap >=2GiB payloads.
+        nbytes = len(data) if is_root and data is not None else 0
+        length = np.array([nbytes >> 31, nbytes & 0x7FFFFFFF], np.int32)
         length = np.asarray(mhu.broadcast_one_to_all(length, is_source=is_root))
-        buf = np.zeros(int(length[0]), np.uint8)
+        buf = np.zeros((int(length[0]) << 31) | int(length[1]), np.uint8)
         if is_root:
             buf[:] = np.frombuffer(data, np.uint8)
         buf = np.asarray(mhu.broadcast_one_to_all(buf, is_source=is_root))

@@ -1,0 +1,29 @@
+"""Model families built on the framework's collectives."""
+
+from rabit_tpu.models.gbdt import (
+    GBDT,
+    GBDTConfig,
+    Forest,
+    TrainState,
+    compute_bin_edges,
+    quantize,
+    init_state,
+    train_round,
+    train_round_dp,
+    predict_margin,
+    predict_proba,
+)
+
+__all__ = [
+    "GBDT",
+    "GBDTConfig",
+    "Forest",
+    "TrainState",
+    "compute_bin_edges",
+    "quantize",
+    "init_state",
+    "train_round",
+    "train_round_dp",
+    "predict_margin",
+    "predict_proba",
+]

@@ -1,0 +1,339 @@
+"""Histogram gradient-boosted decision trees — the flagship workload.
+
+Distributed XGBoost's histogram aggregation is the workload rabit exists for
+(reference doc/guide.md:130-140: each worker builds per-feature gradient
+histograms over its data shard and Allreduces them every tree level;
+BASELINE.json: "XGBoost hist tree_method gradient-histogram allreduce").
+This module is that workload rebuilt TPU-first:
+
+* features are quantized to ``n_bins`` integer bins once, up front;
+* every boosting round grows one depth-``D`` tree level-wise; per level the
+  (node, feature, bin) gradient/hessian histograms are one ``segment_sum``
+  — a static-shape scatter-add XLA maps onto the TPU — and ONE fused
+  ``psum`` across the data-parallel mesh axis (the rabit Allreduce);
+* histogram work is additionally shardable across a feature-parallel mesh
+  axis: each position histograms its feature slice, then one
+  ``all_gather`` reassembles — 2-D (dp, fp) parallelism;
+* everything is jit-compiled with static shapes: the level loop is unrolled
+  (depth is a compile-time constant), rows carry a node index updated by
+  gathers, no data-dependent control flow.
+
+The functional core (``train_round``, ``predict``) is pure and shardable;
+``GBDT`` wraps it for host numpy users, including the rabit-classic
+deployment where each process holds a shard and histograms are combined
+with ``engine.allreduce`` over the native TCP engine.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+class GBDTConfig(NamedTuple):
+    """Static hyperparameters (hashable: usable as a jit static arg)."""
+
+    n_features: int
+    n_trees: int = 20
+    depth: int = 6
+    n_bins: int = 256
+    learning_rate: float = 0.3
+    reg_lambda: float = 1.0
+    min_child_weight: float = 1.0
+    objective: str = "logistic"  # "logistic" | "squared"
+
+
+class Forest(NamedTuple):
+    """A stack of perfect binary trees in level order.
+
+    ``feature``/``threshold``: [n_trees, depth, 2**(depth-1)] — level d of a
+    tree uses the first 2**d entries; thresholds are bin ids (go right when
+    ``bin > threshold``).  ``leaf``: [n_trees, 2**depth] leaf weights.
+    Untrained trees are all-zero and contribute nothing to predictions.
+    """
+
+    feature: jax.Array
+    threshold: jax.Array
+    leaf: jax.Array
+
+
+class TrainState(NamedTuple):
+    forest: Forest
+    margin: jax.Array  # [rows_this_shard] current boosting margin
+    round: jax.Array   # scalar int32: trees built so far
+
+
+def init_forest(cfg: GBDTConfig) -> Forest:
+    max_nodes = 2 ** (cfg.depth - 1)
+    return Forest(
+        feature=jnp.zeros((cfg.n_trees, cfg.depth, max_nodes), jnp.int32),
+        threshold=jnp.zeros((cfg.n_trees, cfg.depth, max_nodes), jnp.int32),
+        leaf=jnp.zeros((cfg.n_trees, 2 ** cfg.depth), jnp.float32),
+    )
+
+
+def init_state(cfg: GBDTConfig, n_rows: int) -> TrainState:
+    return TrainState(
+        forest=init_forest(cfg),
+        margin=jnp.zeros(n_rows, jnp.float32),
+        round=jnp.zeros((), jnp.int32),
+    )
+
+
+# -- quantization ----------------------------------------------------------
+
+
+def compute_bin_edges(X: np.ndarray, n_bins: int) -> np.ndarray:
+    """Per-feature quantile cut points, [n_features, n_bins - 1] (host-side,
+    once per dataset — the 'sketch' phase of hist tree_method)."""
+    qs = np.linspace(0.0, 1.0, n_bins + 1)[1:-1]
+    return np.quantile(np.asarray(X, np.float32), qs, axis=0).T.astype(np.float32)
+
+
+def quantize(X: jax.Array, edges: jax.Array) -> jax.Array:
+    """Map features to integer bins in [0, n_bins): bin = #edges <= x."""
+    find = lambda col, e: jnp.searchsorted(e, col, side="right")
+    return jax.vmap(find, in_axes=(1, 0), out_axes=1)(X, edges).astype(jnp.int32)
+
+
+# -- gradients -------------------------------------------------------------
+
+
+def gradients(cfg: GBDTConfig, margin: jax.Array, y: jax.Array):
+    if cfg.objective == "logistic":
+        p = jax.nn.sigmoid(margin)
+        return p - y, p * (1.0 - p)
+    if cfg.objective == "squared":
+        return margin - y, jnp.ones_like(margin)
+    raise ValueError(f"unknown objective {cfg.objective}")
+
+
+# -- histograms (the hot op) ----------------------------------------------
+
+
+def node_histograms(
+    xb: jax.Array, g: jax.Array, h: jax.Array, node: jax.Array, n_nodes: int, n_bins: int
+) -> jax.Array:
+    """Per-(node, feature, bin) gradient/hessian sums: [n_nodes, F, B, 2].
+
+    One segment_sum over n*F elements with a fused (node,feature,bin) key —
+    the TPU-native form of the reference workload's per-level histogram
+    build (doc/guide.md:130-140).
+    """
+    n, F = xb.shape
+    seg = (node[:, None] * F + jnp.arange(F)[None, :]) * n_bins + xb  # [n, F]
+    gh = jnp.stack(
+        [
+            jnp.broadcast_to(g[:, None], (n, F)),
+            jnp.broadcast_to(h[:, None], (n, F)),
+        ],
+        axis=-1,
+    )  # [n, F, 2]
+    hist = jax.ops.segment_sum(
+        gh.reshape(-1, 2), seg.reshape(-1), num_segments=n_nodes * F * n_bins
+    )
+    return hist.reshape(n_nodes, F, n_bins, 2)
+
+
+def best_splits(hist: jax.Array, cfg: GBDTConfig):
+    """Best (feature, bin, gain) per node from summed histograms.
+
+    Standard XGBoost gain: GL^2/(HL+λ) + GR^2/(HR+λ) − G^2/(H+λ), split
+    candidates are 'bin <= b goes left', invalid when either side's hessian
+    mass is under min_child_weight.
+    """
+    g, h = hist[..., 0], hist[..., 1]            # [nodes, F, B]
+    GL, HL = jnp.cumsum(g, -1), jnp.cumsum(h, -1)
+    G, H = GL[..., -1:], HL[..., -1:]
+    GR, HR = G - GL, H - HL
+    score = lambda a, b: a * a / (b + cfg.reg_lambda)
+    gain = score(GL, HL) + score(GR, HR) - score(G, H)
+    valid = (HL >= cfg.min_child_weight) & (HR >= cfg.min_child_weight)
+    gain = jnp.where(valid, gain, -jnp.inf)
+    flat = gain.reshape(gain.shape[0], -1)
+    best = jnp.argmax(flat, axis=-1)
+    best_gain = jnp.take_along_axis(flat, best[:, None], -1)[:, 0]
+    n_bins = hist.shape[2]
+    return (
+        (best // n_bins).astype(jnp.int32),
+        (best % n_bins).astype(jnp.int32),
+        best_gain,
+    )
+
+
+# -- training --------------------------------------------------------------
+
+
+def _hist_local(xb, g, h, node, n_nodes, n_bins):
+    return node_histograms(xb, g, h, node, n_nodes, n_bins)
+
+
+def train_round(
+    state: TrainState,
+    xb: jax.Array,
+    y: jax.Array,
+    cfg: GBDTConfig,
+    hist_fn: Callable[..., jax.Array] = _hist_local,
+    combine_leaf: Callable[[jax.Array], jax.Array] = lambda gh: gh,
+) -> TrainState:
+    """Grow one tree on (this shard of) the data and append it to the forest.
+
+    ``hist_fn(xb, g, h, node, n_nodes, n_bins) -> [n_nodes, F, B, 2]`` is
+    the histogram-build-and-allreduce hook: plain local histograms for
+    single-shard training; histograms + ``lax.psum`` over the dp axis inside
+    shard_map; a feature-sliced build + psum + all_gather for 2-D (dp, fp);
+    or an engine.allreduce callback in the rabit-classic multi-process
+    deployment.  These hooks are the ONLY communication points — exactly the
+    reference workload's Allreduce placement (doc/guide.md:130-140).
+    """
+    n, F = xb.shape
+    max_nodes = 2 ** (cfg.depth - 1)
+    g, h = gradients(cfg, state.margin, y)
+    node = jnp.zeros(n, jnp.int32)
+    feats, thrs = [], []
+    for d in range(cfg.depth):
+        n_nodes = 2 ** d
+        hist = hist_fn(xb, g, h, node, n_nodes, cfg.n_bins)
+        feat, thr, _gain = best_splits(hist, cfg)
+        feats.append(jnp.zeros(max_nodes, jnp.int32).at[:n_nodes].set(feat))
+        thrs.append(jnp.zeros(max_nodes, jnp.int32).at[:n_nodes].set(thr))
+        # Route every row one level down: right iff bin > threshold.
+        fsel = feat[node]                                        # [n]
+        xv = jnp.take_along_axis(xb, fsel[:, None], 1)[:, 0]
+        node = node * 2 + (xv > thr[node]).astype(jnp.int32)
+    # Leaf weights from summed per-leaf gradient mass.
+    n_leaves = 2 ** cfg.depth
+    leaf_gh = jax.ops.segment_sum(
+        jnp.stack([g, h], -1), node, num_segments=n_leaves
+    )
+    leaf_gh = combine_leaf(leaf_gh)  # [n_leaves, 2] allreduce
+    leaf = -cfg.learning_rate * leaf_gh[:, 0] / (leaf_gh[:, 1] + cfg.reg_lambda)
+    margin = state.margin + leaf[node]
+    t = state.round
+    forest = Forest(
+        feature=lax.dynamic_update_index_in_dim(
+            state.forest.feature, jnp.stack(feats), t, 0
+        ),
+        threshold=lax.dynamic_update_index_in_dim(
+            state.forest.threshold, jnp.stack(thrs), t, 0
+        ),
+        leaf=lax.dynamic_update_index_in_dim(state.forest.leaf, leaf, t, 0),
+    )
+    return TrainState(forest=forest, margin=margin, round=t + 1)
+
+
+def train_round_dp(state, xb, y, cfg, dp_axis: str = "dp", fp_axis: str | None = None):
+    """train_round wired for shard_map: rows sharded over ``dp_axis``; when
+    ``fp_axis`` is given (rows replicated across it), each fp position
+    histograms only its F/fp feature slice — the compute splits — then one
+    psum over dp and one all_gather over fp reassemble the global
+    histogram."""
+    if fp_axis is None:
+        hist_fn = lambda xb, g, h, node, n_nodes, n_bins: lax.psum(
+            node_histograms(xb, g, h, node, n_nodes, n_bins), dp_axis
+        )
+        combine_leaf = lambda gh: lax.psum(gh, dp_axis)
+    else:
+        fp_size = lax.axis_size(fp_axis)
+        f_local = cfg.n_features // fp_size
+        fp_idx = lax.axis_index(fp_axis)
+
+        def hist_fn(xb, g, h, node, n_nodes, n_bins):
+            x_slice = lax.dynamic_slice_in_dim(xb, fp_idx * f_local, f_local, 1)
+            sl = node_histograms(x_slice, g, h, node, n_nodes, n_bins)
+            sl = lax.psum(sl, dp_axis)
+            return lax.all_gather(sl, fp_axis, axis=1, tiled=True)
+
+        # every fp copy sees the same rows: reduce leaves over dp only.
+        combine_leaf = lambda gh: lax.psum(gh, dp_axis)
+    return train_round(state, xb, y, cfg, hist_fn, combine_leaf)
+
+
+# -- prediction ------------------------------------------------------------
+
+
+def predict_margin(forest: Forest, xb: jax.Array, cfg: GBDTConfig) -> jax.Array:
+    """Sum of leaf values over all trees; [n].  Untrained (zero) trees
+    contribute 0, so this is valid mid-training."""
+    n = xb.shape[0]
+
+    def one_tree(margin, tree):
+        feature, threshold, leaf = tree
+        pos = jnp.zeros(n, jnp.int32)
+        for d in range(cfg.depth):
+            f = feature[d][pos]
+            thr = threshold[d][pos]
+            xv = jnp.take_along_axis(xb, f[:, None], 1)[:, 0]
+            pos = pos * 2 + (xv > thr).astype(jnp.int32)
+        return margin + leaf[pos], None
+
+    margin, _ = lax.scan(one_tree, jnp.zeros(n, jnp.float32), forest)
+    return margin
+
+
+def predict_proba(forest: Forest, xb: jax.Array, cfg: GBDTConfig) -> jax.Array:
+    return jax.nn.sigmoid(predict_margin(forest, xb, cfg))
+
+
+# -- host-facing wrapper ---------------------------------------------------
+
+
+class GBDT:
+    """Numpy-in, numpy-out trainer.
+
+    ``engine_allreduce``: optional host allreduce hook (e.g. the native TCP
+    engine's) — the rabit-classic distributed deployment where each process
+    trains on its own shard and only histograms cross the wire.
+    """
+
+    def __init__(self, engine_allreduce: Callable[[np.ndarray], np.ndarray] | None = None, **hyper):
+        self._hyper = hyper
+        self._engine_allreduce = engine_allreduce
+        self.cfg: GBDTConfig | None = None
+        self.forest: Forest | None = None
+        self.edges: np.ndarray | None = None
+
+    def fit(self, X: np.ndarray, y: np.ndarray, warm_state: TrainState | None = None):
+        X = np.asarray(X, np.float32)
+        y = np.asarray(y, np.float32)
+        self.cfg = GBDTConfig(n_features=X.shape[1], **self._hyper)
+        self.edges = compute_bin_edges(X, self.cfg.n_bins)
+        xb = quantize(jnp.asarray(X), jnp.asarray(self.edges))
+        state = warm_state or init_state(self.cfg, X.shape[0])
+
+        if self._engine_allreduce is None:
+            step = jax.jit(functools.partial(train_round, cfg=self.cfg))
+            for _ in range(self.cfg.n_trees):
+                state = step(state, xb, jnp.asarray(y))
+        else:
+            # Histograms leave the device, cross the engine (TCP/XLA), and
+            # come back — the exact reference call pattern.
+            hook = lambda hist: jnp.asarray(self._engine_allreduce(np.asarray(hist)))
+            hist_fn = lambda xb, g, h, node, n_nodes, n_bins: hook(
+                node_histograms(xb, g, h, node, n_nodes, n_bins)
+            )
+            for _ in range(self.cfg.n_trees):
+                state = train_round(state, xb, jnp.asarray(y), self.cfg, hist_fn, hook)
+        self.forest = jax.tree.map(np.asarray, state.forest)
+        self._state = state
+        return self
+
+    def predict_margin(self, X: np.ndarray) -> np.ndarray:
+        if self.forest is None:
+            raise RuntimeError("GBDT.predict called before fit")
+        xb = quantize(jnp.asarray(np.asarray(X, np.float32)), jnp.asarray(self.edges))
+        fn = jax.jit(functools.partial(predict_margin, cfg=self.cfg))
+        return np.asarray(fn(jax.tree.map(jnp.asarray, self.forest), xb))
+
+    def predict_proba(self, X: np.ndarray) -> np.ndarray:
+        return 1.0 / (1.0 + np.exp(-self.predict_margin(X)))
+
+    def predict(self, X: np.ndarray) -> np.ndarray:
+        if self.cfg.objective == "logistic":
+            return (self.predict_margin(X) > 0).astype(np.int32)
+        return self.predict_margin(X)

@@ -18,7 +18,6 @@ All functions take an ``axis_name`` and must run inside ``shard_map`` /
 
 from __future__ import annotations
 
-import functools
 from typing import Any
 
 import jax
@@ -26,6 +25,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from rabit_tpu.engine.base import BITOR, MAX, MIN, SUM
+from rabit_tpu.parallel.mesh import ring_perm
 
 Array = jax.Array
 
@@ -59,7 +59,7 @@ def broadcast(x: Array, axis_name: str, root: int = 0) -> Array:
     all-reduce-from-one)."""
     idx = lax.axis_index(axis_name)
     contrib = jnp.where(idx == root, x, jnp.zeros_like(x))
-    if jnp.issubdtype(x.dtype, jnp.integer) or x.dtype == jnp.bool_:
+    if x.dtype == jnp.bool_:
         return lax.psum(contrib.astype(jnp.int32), axis_name).astype(x.dtype)
     return lax.psum(contrib, axis_name)
 
@@ -78,8 +78,7 @@ def ring_shift(x: Any, axis_name: str, shift: int = 1) -> Any:
     Works on pytrees.  The generic streaming primitive (reference:
     RingPassing, allreduce_robust.cc:1529-1587)."""
     n = lax.axis_size(axis_name)
-    perm = [(i, (i + shift) % n) for i in range(n)]
-    return lax.ppermute(x, axis_name, perm)
+    return lax.ppermute(x, axis_name, ring_perm(n, shift))
 
 
 def ring_reduce_scatter(x: Array, axis_name: str) -> Array:
@@ -93,7 +92,7 @@ def ring_reduce_scatter(x: Array, axis_name: str) -> Array:
     """
     n = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
-    perm = [(i, (i + 1) % n) for i in range(n)]
+    perm = ring_perm(n)
     chunks = x.reshape((n, x.shape[0] // n) + x.shape[1:])
 
     def body(s, send):
@@ -112,7 +111,7 @@ def ring_allgather(x: Array, axis_name: str) -> Array:
     compose, engine.h:56-79)."""
     n = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
-    perm = [(i, (i + 1) % n) for i in range(n)]
+    perm = ring_perm(n)
     out = jnp.zeros((n,) + x.shape, x.dtype)
     out = lax.dynamic_update_index_in_dim(out, x, idx, 0)
 

@@ -16,11 +16,11 @@ block i.  Run inside ``shard_map``.
 
 from __future__ import annotations
 
-import functools
-
 import jax
 import jax.numpy as jnp
 from jax import lax
+
+from rabit_tpu.parallel.mesh import ring_perm
 
 _NEG_INF = -1e30
 
@@ -56,13 +56,13 @@ def ring_attention(
     """
     n = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
-    perm = [(i, (i + 1) % n) for i in range(n)]
+    perm = ring_perm(n)
     block, heads, dim = q.shape
     scale = 1.0 / (dim ** 0.5)
     q_pos = idx * block + jnp.arange(block)
 
-    def step(carry, s):
-        o, m, l, kb, vb = carry
+    def merge(carry, kb, vb, s):
+        o, m, l = carry
         # The k/v block in hand after s hops originated s positions back.
         src = (idx - s) % n
         k_pos = src * block + jnp.arange(block)
@@ -74,17 +74,23 @@ def ring_attention(
         beta = jnp.where(bm <= _NEG_INF / 2, 0.0, beta)
         o = o * alpha.T[..., None] + bo * beta.T[..., None]
         l = l * alpha + bl * beta
+        return o, m_new, l
+
+    def step(carry, s):
+        o, m, l, kb, vb = carry
         # Rotate K/V to the ring successor — one ICI hop, overlapped by XLA
-        # with the next block's compute.
+        # with this block's compute — then fold the arriving block in.
         kb, vb = lax.ppermute((kb, vb), axis_name, perm)
-        return (o, m_new, l, kb, vb), None
+        o, m, l = merge((o, m, l), kb, vb, s)
+        return (o, m, l, kb, vb), None
 
     o0 = jnp.zeros_like(q, dtype=jnp.float32)  # inherits q's vma
     m0 = lax.pvary(jnp.full((heads, block), _NEG_INF, dtype=jnp.float32), axis_name)
     l0 = lax.pvary(jnp.zeros((heads, block), dtype=jnp.float32), axis_name)
-    (o, m, l, _, _), _ = lax.scan(
-        step, (o0, m0, l0, k.astype(jnp.float32), v.astype(jnp.float32)), jnp.arange(n)
-    )
+    # Fold the local block first, then n-1 rotate-and-fold steps.
+    kf, vf = k.astype(jnp.float32), v.astype(jnp.float32)
+    o, m, l = merge((o0, m0, l0), kf, vf, 0)
+    (o, m, l, _, _), _ = lax.scan(step, (o, m, l, kf, vf), jnp.arange(1, n))
     l = jnp.where(l == 0.0, 1.0, l)
     return (o / l.T[..., None]).astype(q.dtype)
 
