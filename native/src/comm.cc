@@ -1,0 +1,418 @@
+#include "comm.h"
+
+#include <unistd.h>
+
+#include <algorithm>
+#include <cstring>
+
+namespace tpurabit {
+
+namespace {
+
+void PutU32(std::string* out, uint32_t v) {
+  out->append(reinterpret_cast<const char*>(&v), 4);  // little-endian hosts
+}
+
+void PutI32(std::string* out, int32_t v) {
+  out->append(reinterpret_cast<const char*>(&v), 4);
+}
+
+void PutStr(std::string* out, const std::string& s) {
+  PutU32(out, static_cast<uint32_t>(s.size()));
+  out->append(s);
+}
+
+uint32_t GetU32(TcpSocket* s) {
+  uint32_t v;
+  s->RecvAll(&v, 4);
+  return v;
+}
+
+int32_t GetI32(TcpSocket* s) {
+  int32_t v;
+  s->RecvAll(&v, 4);
+  return v;
+}
+
+std::string GetStr(TcpSocket* s) {
+  uint32_t n = GetU32(s);
+  std::string out(n, '\0');
+  if (n > 0) s->RecvAll(out.data(), n);
+  return out;
+}
+
+}  // namespace
+
+void Comm::Configure(const Config& cfg) {
+  cfg_ = cfg;
+  tracker_host_ = cfg.Get("rabit_tracker_uri", "NULL");
+  tracker_port_ = static_cast<int>(cfg.GetInt("rabit_tracker_port", 9091));
+  task_id_ = cfg.Get("rabit_task_id", "NULL");
+  if (task_id_ == "NULL" || task_id_.empty()) {
+    // Workers launched by hand (no launcher-assigned task id) must not
+    // collide at the tracker, whose wave dedup is keyed by task id.
+    char buf[300];
+    char hn[256];
+    gethostname(hn, sizeof(hn));
+    snprintf(buf, sizeof(buf), "%s:%d", hn, static_cast<int>(getpid()));
+    task_id_ = buf;
+  }
+  ring_mincount_ = cfg.GetSize("rabit_reduce_ring_mincount", 32 << 10);
+  tree_minsize_ = cfg.GetSize("rabit_tree_reduce_minsize", 1 << 20);
+  tcp_no_delay_ = cfg.GetBool("rabit_enable_tcp_no_delay", false);
+  char buf[256];
+  gethostname(buf, sizeof(buf));
+  host_name_ = buf;
+}
+
+void Comm::ConnectTracker(TcpSocket* sock) const {
+  sock->Connect(tracker_host_, tracker_port_,
+                static_cast<int>(cfg_.GetInt("rabit_connect_retry", 5)));
+}
+
+void Comm::SendHello(TcpSocket* sock, uint32_t cmd) const {
+  std::string msg;
+  PutU32(&msg, kMagicHello);
+  PutU32(&msg, cmd);
+  PutI32(&msg, initialized_ ? rank_ : -1);
+  PutStr(&msg, task_id_);
+  if (cmd == kCmdStart || cmd == kCmdRecover) {
+    PutU32(&msg, static_cast<uint32_t>(listen_port_));
+  }
+  sock->SendAll(msg.data(), msg.size());
+}
+
+void Comm::RecvAssignment(TcpSocket* sock) {
+  uint32_t magic = GetU32(sock);
+  TRT_CHECK(magic == kMagicAssign, "bad assignment magic %#x", magic);
+  rank_ = GetI32(sock);
+  world_ = static_cast<int>(GetU32(sock));
+  parent_ = GetI32(sock);
+  uint32_t nchildren = GetU32(sock);
+  children_.clear();
+  for (uint32_t i = 0; i < nchildren; ++i) children_.push_back(GetI32(sock));
+  ring_prev_ = GetI32(sock);
+  ring_next_ = GetI32(sock);
+  peers_.clear();
+  uint32_t npeers = GetU32(sock);
+  for (uint32_t i = 0; i < npeers; ++i) {
+    int r = GetI32(sock);
+    std::string host = GetStr(sock);
+    int port = static_cast<int>(GetU32(sock));
+    peers_[r] = {host, port};
+  }
+  epoch_ = static_cast<int>(GetU32(sock));
+}
+
+void Comm::Init(bool recover) {
+  if (tracker_host_ == "NULL" || tracker_host_.empty()) {
+    rank_ = 0;
+    world_ = 1;
+    initialized_ = true;
+    return;  // solo mode (reference: allreduce_base.cc:265-267)
+  }
+  if (!listen_.valid()) {
+    listen_.Create();
+    listen_port_ = listen_.BindListen();
+  }
+  TcpSocket tr;
+  ConnectTracker(&tr);
+  SendHello(&tr, recover ? kCmdRecover : kCmdStart);
+  RecvAssignment(&tr);
+  tr.Close();
+  BuildLinks();
+  initialized_ = true;
+}
+
+void Comm::BuildLinks() {
+  CloseLinks();
+  std::set<int> neighbors;
+  if (parent_ >= 0) neighbors.insert(parent_);
+  for (int c : children_) neighbors.insert(c);
+  if (world_ > 1) {
+    neighbors.insert(ring_prev_);
+    neighbors.insert(ring_next_);
+  }
+  neighbors.erase(rank_);
+
+  // Lower rank dials, higher rank accepts.  Every worker is listening
+  // before the tracker releases the assignment wave, so dials always land.
+  int expect_accept = 0;
+  for (int peer : neighbors) {
+    if (peer > rank_) {
+      auto it = peers_.find(peer);
+      TRT_CHECK(it != peers_.end(), "no address for peer %d", peer);
+      TcpSocket s;
+      s.Connect(it->second.first, it->second.second);
+      uint32_t hello[3] = {kMagicLink, static_cast<uint32_t>(rank_),
+                           static_cast<uint32_t>(epoch_)};
+      s.SendAll(hello, sizeof(hello));
+      links_[peer] = std::move(s);
+    } else {
+      ++expect_accept;
+    }
+  }
+  while (expect_accept > 0) {
+    TcpSocket s = listen_.Accept();
+    uint32_t hello[3];
+    s.RecvAll(hello, sizeof(hello));
+    if (hello[0] != kMagicLink ||
+        static_cast<int>(hello[2]) != epoch_) {
+      continue;  // stale dialer from a previous epoch; drop
+    }
+    int peer = static_cast<int>(hello[1]);
+    TRT_CHECK(neighbors.count(peer) == 1 && peer < rank_,
+              "unexpected link from rank %d", peer);
+    links_[peer] = std::move(s);
+    --expect_accept;
+  }
+  for (auto& [peer, sock] : links_) {
+    sock.SetNonBlock(true);
+    sock.SetKeepAlive(true);
+    if (tcp_no_delay_) sock.SetNoDelay(true);
+  }
+}
+
+void Comm::CloseLinks() {
+  links_.clear();  // RAII closes fds
+}
+
+void Comm::Shutdown() {
+  if (tracker_host_ != "NULL" && !tracker_host_.empty() && initialized_) {
+    try {
+      TcpSocket tr;
+      ConnectTracker(&tr);
+      SendHello(&tr, kCmdShutdown);
+      GetU32(&tr);  // ack
+    } catch (const Error&) {
+      // tracker already gone; shutting down anyway
+    }
+  }
+  CloseLinks();
+  listen_.Close();
+  initialized_ = false;
+}
+
+void Comm::TrackerPrint(const std::string& msg) {
+  if (tracker_host_ == "NULL" || tracker_host_.empty()) {
+    fprintf(stdout, "%s%s", msg.c_str(), msg.empty() || msg.back() != '\n' ? "\n" : "");
+    fflush(stdout);
+    return;
+  }
+  TcpSocket tr;
+  ConnectTracker(&tr);
+  std::string m;
+  PutU32(&m, kMagicHello);
+  PutU32(&m, kCmdPrint);
+  PutI32(&m, rank_);
+  PutStr(&m, task_id_);
+  PutStr(&m, msg);
+  tr.SendAll(m.data(), m.size());
+  GetU32(&tr);  // ack
+}
+
+TcpSocket* Comm::LinkTo(int peer_rank) {
+  auto it = links_.find(peer_rank);
+  TRT_CHECK(it != links_.end(), "no link to rank %d", peer_rank);
+  return &it->second;
+}
+
+// --- collectives ----------------------------------------------------------
+
+IoResult Comm::Allreduce(void* buf, size_t elem_size, size_t count,
+                         ReduceFn fn, void* ctx) {
+  if (world_ <= 1) return IoResult::kOk;
+  // Ring for bandwidth-bound sizes, tree for latency-bound — same policy
+  // and default threshold as the reference (allreduce_base.cc:454-464).
+  if (count > ring_mincount_ && static_cast<size_t>(world_) <= count) {
+    return AllreduceRing(static_cast<char*>(buf), elem_size, count, fn, ctx);
+  }
+  return AllreduceTree(static_cast<char*>(buf), elem_size, count, fn, ctx);
+}
+
+IoResult Comm::AllreduceTree(char* buf, size_t elem_size, size_t count,
+                             ReduceFn fn, void* ctx) {
+  const size_t total = elem_size * count;
+  // Pipeline in chunks of whole elements (reference tree_reduce_minsize).
+  size_t chunk = std::max(tree_minsize_ / elem_size, size_t(1)) * elem_size;
+  chunk = std::min(chunk, total);
+  std::vector<TcpSocket*> kids;
+  for (int c : children_) kids.push_back(LinkTo(c));
+  TcpSocket* up = parent_ >= 0 ? LinkTo(parent_) : nullptr;
+  std::vector<std::vector<char>> childbuf(kids.size(),
+                                          std::vector<char>(chunk));
+  // Up-sweep: reduce children into `buf`, forward chunk to parent.
+  for (size_t off = 0; off < total; off += chunk) {
+    size_t n = std::min(chunk, total - off);
+    std::vector<Transfer> ts;
+    for (size_t i = 0; i < kids.size(); ++i) {
+      ts.push_back({kids[i]->fd(), childbuf[i].data(), n, 0, false});
+    }
+    if (!ts.empty() &&
+        DriveTransfers(ts.data(), static_cast<int>(ts.size())) != IoResult::kOk) {
+      return IoResult::kPeerFailure;
+    }
+    for (size_t i = 0; i < kids.size(); ++i) {
+      fn(buf + off, childbuf[i].data(), n / elem_size, ctx);
+    }
+    if (up != nullptr) {
+      Transfer t{up->fd(), buf + off, n, 0, true};
+      if (DriveTransfers(&t, 1) != IoResult::kOk) return IoResult::kPeerFailure;
+    }
+  }
+  // Down-sweep: receive final chunks from parent, fan to children.
+  for (size_t off = 0; off < total; off += chunk) {
+    size_t n = std::min(chunk, total - off);
+    if (up != nullptr) {
+      Transfer t{up->fd(), buf + off, n, 0, false};
+      if (DriveTransfers(&t, 1) != IoResult::kOk) return IoResult::kPeerFailure;
+    }
+    std::vector<Transfer> ts;
+    for (TcpSocket* kid : kids) {
+      ts.push_back({kid->fd(), buf + off, n, 0, true});
+    }
+    if (!ts.empty() &&
+        DriveTransfers(ts.data(), static_cast<int>(ts.size())) != IoResult::kOk) {
+      return IoResult::kPeerFailure;
+    }
+  }
+  return IoResult::kOk;
+}
+
+IoResult Comm::AllreduceRing(char* buf, size_t elem_size, size_t count,
+                             ReduceFn fn, void* ctx) {
+  const int n = world_;
+  TcpSocket* next = LinkTo(ring_next_);
+  TcpSocket* prev = LinkTo(ring_prev_);
+  // Chunk c covers elements [c*count/n, (c+1)*count/n).
+  auto chunk_begin = [&](int c) { return (static_cast<size_t>(c) * count / n) * elem_size; };
+  auto chunk_size = [&](int c) {
+    return (static_cast<size_t>(c + 1) * count / n -
+            static_cast<size_t>(c) * count / n) * elem_size;
+  };
+  size_t maxchunk = 0;
+  for (int c = 0; c < n; ++c) maxchunk = std::max(maxchunk, chunk_size(c));
+  std::vector<char> tmp(maxchunk);
+  // Reduce-scatter: step s sends chunk (rank-s), receives+folds (rank-s-1).
+  for (int s = 0; s < n - 1; ++s) {
+    int sc = ((rank_ - s) % n + n) % n;
+    int rc = ((rank_ - s - 1) % n + n) % n;
+    Transfer ts[2] = {
+        {next->fd(), buf + chunk_begin(sc), chunk_size(sc), 0, true},
+        {prev->fd(), tmp.data(), chunk_size(rc), 0, false},
+    };
+    if (DriveTransfers(ts, 2) != IoResult::kOk) return IoResult::kPeerFailure;
+    fn(buf + chunk_begin(rc), tmp.data(), chunk_size(rc) / elem_size, ctx);
+  }
+  // Allgather: rank owns chunk (rank+1); circulate owned chunks.
+  for (int s = 0; s < n - 1; ++s) {
+    int sc = ((rank_ + 1 - s) % n + n) % n;
+    int rc = ((rank_ - s) % n + n) % n;
+    Transfer ts[2] = {
+        {next->fd(), buf + chunk_begin(sc), chunk_size(sc), 0, true},
+        {prev->fd(), buf + chunk_begin(rc), chunk_size(rc), 0, false},
+    };
+    if (DriveTransfers(ts, 2) != IoResult::kOk) return IoResult::kPeerFailure;
+  }
+  return IoResult::kOk;
+}
+
+IoResult Comm::Broadcast(void* data, size_t size, int root) {
+  if (world_ <= 1 || size == 0) return IoResult::kOk;
+  char* buf = static_cast<char*>(data);
+  // The in-link is the tree neighbor on the path to root (statically
+  // computable in a heap-numbered tree, unlike the reference's dynamic
+  // in-link discovery, allreduce_base.cc:687-763).
+  auto is_ancestor_or_self = [](int a, int b) {
+    // true iff a is on the path from b up to the heap root
+    while (b > a) b = (b - 1) / 2;
+    return a == b;
+  };
+  int in_link = -2;  // -2: I am root
+  if (rank_ != root) {
+    in_link = parent_;
+    for (int c : children_) {
+      if (is_ancestor_or_self(c, root)) { in_link = c; break; }
+    }
+  }
+  std::vector<TcpSocket*> out;
+  if (parent_ >= 0 && parent_ != in_link) out.push_back(LinkTo(parent_));
+  for (int c : children_) {
+    if (c != in_link) out.push_back(LinkTo(c));
+  }
+  size_t chunk = std::min(std::max(tree_minsize_, size_t(1)), size);
+  for (size_t off = 0; off < size; off += chunk) {
+    size_t nb = std::min(chunk, size - off);
+    if (in_link >= 0) {
+      Transfer t{LinkTo(in_link)->fd(), buf + off, nb, 0, false};
+      if (DriveTransfers(&t, 1) != IoResult::kOk) return IoResult::kPeerFailure;
+    }
+    std::vector<Transfer> ts;
+    for (TcpSocket* o : out) ts.push_back({o->fd(), buf + off, nb, 0, true});
+    if (!ts.empty() &&
+        DriveTransfers(ts.data(), static_cast<int>(ts.size())) != IoResult::kOk) {
+      return IoResult::kPeerFailure;
+    }
+  }
+  return IoResult::kOk;
+}
+
+IoResult Comm::RingExchange(const void* send, size_t send_bytes, void* recv,
+                            size_t recv_bytes) {
+  if (world_ <= 1) {
+    TRT_CHECK(send_bytes == recv_bytes, "solo ring exchange size mismatch");
+    memcpy(recv, send, send_bytes);
+    return IoResult::kOk;
+  }
+  Transfer ts[2] = {
+      {LinkTo(ring_next_)->fd(), const_cast<char*>(static_cast<const char*>(send)),
+       send_bytes, 0, true},
+      {LinkTo(ring_prev_)->fd(), static_cast<char*>(recv), recv_bytes, 0, false},
+  };
+  return DriveTransfers(ts, 2);
+}
+
+IoResult Comm::Allgather(const void* mine, size_t slice_bytes, void* out) {
+  char* obuf = static_cast<char*>(out);
+  memcpy(obuf + static_cast<size_t>(rank_) * slice_bytes, mine, slice_bytes);
+  if (world_ <= 1 || slice_bytes == 0) return IoResult::kOk;
+  const int n = world_;
+  // Circulate slices around the ring: step s sends slice (rank-s),
+  // receives slice (rank-s-1) — the reference's TryAllgatherRing pattern.
+  for (int s = 0; s < n - 1; ++s) {
+    int sc = ((rank_ - s) % n + n) % n;
+    int rc = ((rank_ - s - 1) % n + n) % n;
+    IoResult r = RingExchange(obuf + static_cast<size_t>(sc) * slice_bytes,
+                              slice_bytes,
+                              obuf + static_cast<size_t>(rc) * slice_bytes,
+                              slice_bytes);
+    if (r != IoResult::kOk) return r;
+  }
+  return IoResult::kOk;
+}
+
+IoResult Comm::AllgatherV(const void* mine, size_t my_bytes,
+                          std::vector<std::vector<char>>* out) {
+  const int n = world_;
+  out->assign(n, {});
+  (*out)[rank_].assign(static_cast<const char*>(mine),
+                       static_cast<const char*>(mine) + my_bytes);
+  if (n <= 1) return IoResult::kOk;
+  // Pass 1: ring-allgather the size table; pass 2: stream the slices.
+  std::vector<uint64_t> sizes(n, 0);
+  sizes[rank_] = my_bytes;
+  uint64_t my_size = my_bytes;
+  IoResult r = Allgather(&my_size, sizeof(uint64_t), sizes.data());
+  if (r != IoResult::kOk) return r;
+  for (int i = 0; i < n; ++i) (*out)[i].resize(sizes[i]);
+  for (int s = 0; s < n - 1; ++s) {
+    int sc = ((rank_ - s) % n + n) % n;
+    int rc = ((rank_ - s - 1) % n + n) % n;
+    r = RingExchange((*out)[sc].data(), (*out)[sc].size(), (*out)[rc].data(),
+                     (*out)[rc].size());
+    if (r != IoResult::kOk) return r;
+  }
+  return IoResult::kOk;
+}
+
+}  // namespace tpurabit

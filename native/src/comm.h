@@ -1,0 +1,109 @@
+// Communicator: tracker bootstrap, peer links, tree + ring collectives.
+//
+// Capability parity with the reference's AllreduceBase
+// (/root/reference/src/allreduce_base.{h,cc}: ReConnectLinks bootstrap,
+// TryAllreduceTree/TryAllreduceRing/TryBroadcast/TryAllgatherRing) with a
+// redesigned bootstrap: the tracker hands every worker the full peer table
+// in one round-trip per wave (see rabit_tpu/tracker/protocol.py), lower
+// rank dials higher, and recovery rebuilds ALL links in a fresh epoch
+// instead of incrementally repairing good ones.  Collectives return
+// IoResult::kPeerFailure when a peer dies mid-operation; the robust engine
+// reacts, the base engine raises.
+#pragma once
+
+#include <cstdint>
+#include <map>
+#include <set>
+#include <string>
+#include <vector>
+
+#include "common.h"
+#include "socket.h"
+
+namespace tpurabit {
+
+// Wire constants shared with rabit_tpu/tracker/protocol.py.
+constexpr uint32_t kMagicHello = 0x7AB17001;
+constexpr uint32_t kMagicAssign = 0x7AB17002;
+constexpr uint32_t kMagicLink = 0x7AB17003;
+constexpr uint32_t kCmdStart = 1;
+constexpr uint32_t kCmdRecover = 2;
+constexpr uint32_t kCmdPrint = 3;
+constexpr uint32_t kCmdShutdown = 4;
+
+// dst[i] = reduce(dst[i], src[i]) over `count` elements.
+using ReduceFn = void (*)(void* dst, const void* src, size_t count, void* ctx);
+
+class Comm {
+ public:
+  void Configure(const Config& cfg);
+
+  // Bootstrap against the tracker ("start") or re-bootstrap after a failure
+  // ("recover"); no-op solo mode when no tracker is configured.
+  void Init(bool recover);
+  void Shutdown();       // notify tracker, close links
+  void CloseLinks();     // drop all peer links (recovery prelude)
+
+  int rank() const { return rank_; }
+  int world() const { return world_; }
+  int epoch() const { return epoch_; }
+  int ring_prev() const { return ring_prev_; }
+  int ring_next() const { return ring_next_; }
+  bool distributed() const { return world_ > 1; }
+  const std::string& host() const { return host_name_; }
+
+  void TrackerPrint(const std::string& msg);
+
+  // --- collectives (buffers are raw bytes; count*elem_size = span) ------
+  // Tree vs ring selected by element count like the reference
+  // (allreduce_base.cc:454-464, reduce_ring_mincount).
+  IoResult Allreduce(void* buf, size_t elem_size, size_t count, ReduceFn fn,
+                     void* ctx);
+  IoResult Broadcast(void* buf, size_t size, int root);
+  // Equal slices: `mine` (slice_bytes) from every rank into out
+  // (world*slice_bytes, rank-ordered).
+  IoResult Allgather(const void* mine, size_t slice_bytes, void* out);
+  // Uneven slices: per-rank sizes are exchanged first, then slices ring
+  // around (the reference's slice-addressed TryAllgatherRing capability).
+  IoResult AllgatherV(const void* mine, size_t my_bytes,
+                      std::vector<std::vector<char>>* out);
+  // Generic ring streaming (reference RingPassing): send my block to ring
+  // successor, receive predecessor's.
+  IoResult RingExchange(const void* send, size_t send_bytes, void* recv,
+                        size_t recv_bytes);
+
+  IoResult AllreduceTree(char* buf, size_t elem_size, size_t count,
+                         ReduceFn fn, void* ctx);
+  IoResult AllreduceRing(char* buf, size_t elem_size, size_t count,
+                         ReduceFn fn, void* ctx);
+
+ private:
+  void ConnectTracker(TcpSocket* sock) const;
+  void SendHello(TcpSocket* sock, uint32_t cmd) const;
+  void RecvAssignment(TcpSocket* sock);
+  void BuildLinks();
+  TcpSocket* LinkTo(int peer_rank);
+
+  Config cfg_;
+  std::string tracker_host_ = "NULL";
+  int tracker_port_ = 9091;
+  std::string task_id_ = "0";
+  std::string host_name_;
+  int rank_ = 0;
+  int world_ = 1;
+  int epoch_ = -1;
+  int parent_ = -1;
+  std::vector<int> children_;
+  int ring_prev_ = -1;
+  int ring_next_ = -1;
+  std::map<int, std::pair<std::string, int>> peers_;
+  TcpSocket listen_;
+  int listen_port_ = 0;
+  std::map<int, TcpSocket> links_;
+  size_t ring_mincount_ = 32 << 10;   // rabit_reduce_ring_mincount
+  size_t tree_minsize_ = 1 << 20;     // rabit_tree_reduce_minsize (chunk)
+  bool tcp_no_delay_ = false;
+  bool initialized_ = false;
+};
+
+}  // namespace tpurabit

@@ -1,0 +1,115 @@
+// Substrate: errors, logging, time, config.
+//
+// Capability parity with the reference's L1 utils
+// (/root/reference/include/rabit/internal/utils.h: Assert/Check/Error that
+// throw so the robust engine can catch and recover; timer.h GetTime;
+// the k=v SetParam config chains) redesigned as C++17: one exception type,
+// a std::map config with typed getters, variadic formatting.
+#pragma once
+
+#include <chrono>
+#include <cstdarg>
+#include <cstdio>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <stdexcept>
+#include <string>
+
+namespace tpurabit {
+
+// All internal failures throw Error; the C ABI boundary converts to
+// error codes + message (reference throws dmlc::Error through its C API).
+class Error : public std::runtime_error {
+ public:
+  explicit Error(const std::string& msg) : std::runtime_error(msg) {}
+};
+
+inline std::string Format(const char* fmt, ...) {
+  char buf[512];
+  va_list ap;
+  va_start(ap, fmt);
+  vsnprintf(buf, sizeof(buf), fmt, ap);
+  va_end(ap);
+  return std::string(buf);
+}
+
+#define TRT_CHECK(cond, ...)                                   \
+  do {                                                         \
+    if (!(cond)) throw ::tpurabit::Error(::tpurabit::Format(__VA_ARGS__)); \
+  } while (0)
+
+inline double NowSec() {
+  using clock = std::chrono::steady_clock;
+  return std::chrono::duration<double>(clock::now().time_since_epoch()).count();
+}
+
+// Layered k=v config: defaults <- env watch-list <- argv pairs.
+class Config {
+ public:
+  void Set(const std::string& k, const std::string& v) { kv_[k] = v; }
+  bool Has(const std::string& k) const { return kv_.count(k) != 0; }
+  std::string Get(const std::string& k, const std::string& dflt = "") const {
+    auto it = kv_.find(k);
+    return it == kv_.end() ? dflt : it->second;
+  }
+  long GetInt(const std::string& k, long dflt = 0) const {
+    auto it = kv_.find(k);
+    return it == kv_.end() ? dflt : std::stol(it->second);
+  }
+  bool GetBool(const std::string& k, bool dflt = false) const {
+    auto it = kv_.find(k);
+    if (it == kv_.end()) return dflt;
+    const std::string& v = it->second;
+    return !(v == "0" || v == "false" || v == "no" || v == "off" || v.empty());
+  }
+  // "256M"-style sizes.
+  size_t GetSize(const std::string& k, size_t dflt = 0) const {
+    auto it = kv_.find(k);
+    if (it == kv_.end()) return dflt;
+    std::string v = it->second;
+    size_t mult = 1;
+    if (!v.empty()) {
+      switch (v.back()) {
+        case 'K': case 'k': mult = 1ull << 10; v.pop_back(); break;
+        case 'M': case 'm': mult = 1ull << 20; v.pop_back(); break;
+        case 'G': case 'g': mult = 1ull << 30; v.pop_back(); break;
+        case 'B': case 'b': v.pop_back(); break;
+      }
+    }
+    return static_cast<size_t>(std::stod(v) * mult);
+  }
+  void LoadEnv();                       // DMLC_*/rabit_* watch list
+  void LoadArgs(int argc, char** argv); // "k=v" pairs
+
+ private:
+  std::map<std::string, std::string> kv_;
+};
+
+inline void Config::LoadEnv() {
+  static const struct { const char* env; const char* key; } kMap[] = {
+      {"DMLC_TRACKER_URI", "rabit_tracker_uri"},
+      {"DMLC_TRACKER_PORT", "rabit_tracker_port"},
+      {"DMLC_TASK_ID", "rabit_task_id"},
+      {"DMLC_ROLE", "rabit_role"},
+      {"DMLC_NUM_ATTEMPT", "rabit_num_trial"},
+      {"DMLC_WORKER_CONNECT_RETRY", "rabit_connect_retry"},
+      {"rabit_global_replica", "rabit_global_replica"},
+      {"rabit_local_replica", "rabit_local_replica"},
+  };
+  for (const auto& m : kMap) {
+    const char* v = getenv(m.env);
+    if (v != nullptr) Set(m.key, v);
+  }
+}
+
+inline void Config::LoadArgs(int argc, char** argv) {
+  for (int i = 0; i < argc; ++i) {
+    const char* eq = strchr(argv[i], '=');
+    if (eq != nullptr) {
+      Set(std::string(argv[i], eq - argv[i]), std::string(eq + 1));
+    }
+  }
+}
+
+}  // namespace tpurabit

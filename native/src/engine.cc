@@ -1,0 +1,140 @@
+#include "engine.h"
+
+#include <memory>
+
+namespace tpurabit {
+
+size_t DTypeSize(int dtype) {
+  switch (dtype) {
+    case kInt8: case kUInt8: return 1;
+    case kInt32: case kUInt32: return 4;
+    case kInt64: case kUInt64: case kFloat64: return 8;
+    case kFloat32: return 4;
+    default: throw Error(Format("unknown dtype %d", dtype));
+  }
+}
+
+namespace {
+
+template <typename T>
+void ReduceMax(void* dst, const void* src, size_t n, void*) {
+  T* d = static_cast<T*>(dst);
+  const T* s = static_cast<const T*>(src);
+  for (size_t i = 0; i < n; ++i) d[i] = s[i] > d[i] ? s[i] : d[i];
+}
+
+template <typename T>
+void ReduceMin(void* dst, const void* src, size_t n, void*) {
+  T* d = static_cast<T*>(dst);
+  const T* s = static_cast<const T*>(src);
+  for (size_t i = 0; i < n; ++i) d[i] = s[i] < d[i] ? s[i] : d[i];
+}
+
+template <typename T>
+void ReduceSum(void* dst, const void* src, size_t n, void*) {
+  T* d = static_cast<T*>(dst);
+  const T* s = static_cast<const T*>(src);
+  for (size_t i = 0; i < n; ++i) d[i] += s[i];
+}
+
+template <typename T>
+void ReduceBitOr(void* dst, const void* src, size_t n, void*) {
+  T* d = static_cast<T*>(dst);
+  const T* s = static_cast<const T*>(src);
+  for (size_t i = 0; i < n; ++i) d[i] |= s[i];
+}
+
+template <typename T>
+ReduceFn PickOp(int op) {
+  switch (op) {
+    case kMax: return ReduceMax<T>;
+    case kMin: return ReduceMin<T>;
+    case kSum: return ReduceSum<T>;
+    default: return nullptr;  // kBitOr only valid via PickIntOp
+  }
+}
+
+template <typename T>
+ReduceFn PickIntOp(int op) {
+  if (op == kBitOr) return ReduceBitOr<T>;
+  return PickOp<T>(op);
+}
+
+}  // namespace
+
+ReduceFn BuiltinReducer(int op, int dtype) {
+  switch (dtype) {
+    case kInt8: return PickIntOp<int8_t>(op);
+    case kUInt8: return PickIntOp<uint8_t>(op);
+    case kInt32: return PickIntOp<int32_t>(op);
+    case kUInt32: return PickIntOp<uint32_t>(op);
+    case kInt64: return PickIntOp<int64_t>(op);
+    case kUInt64: return PickIntOp<uint64_t>(op);
+    case kFloat32: return PickOp<float>(op);
+    case kFloat64: return PickOp<double>(op);
+    default: return nullptr;
+  }
+}
+
+void BaseEngine::Allgather(void* buf, size_t total, size_t beg, size_t end,
+                           const char*) {
+  if (comm_.world() <= 1) return;
+  char* b = static_cast<char*>(buf);
+  std::vector<std::vector<char>> parts;
+  Must(comm_.AllgatherV(b + beg, end - beg, &parts), "allgather");
+  size_t off = 0;
+  for (const auto& p : parts) {
+    TRT_CHECK(off + p.size() <= total, "allgather total size too small");
+    memcpy(b + off, p.data(), p.size());
+    off += p.size();
+  }
+  TRT_CHECK(off == total, "allgather size mismatch: %zu != %zu", off, total);
+}
+
+// --- singleton ------------------------------------------------------------
+
+namespace {
+std::unique_ptr<Engine> g_engine;
+EmptyEngine g_default_engine;  // zero-config solo fallback
+}  // namespace
+
+Engine* GetEngine() {
+  return g_engine != nullptr ? g_engine.get() : &g_default_engine;
+}
+
+std::unique_ptr<Engine> CreateRobustEngine();  // robust.cc
+std::unique_ptr<Engine> CreateMockEngine();    // robust.cc (mock wraps robust)
+
+void InitEngine(int argc, char** argv) {
+  TRT_CHECK(g_engine == nullptr, "engine already initialized");
+  Config cfg;
+  cfg.LoadEnv();
+  cfg.LoadArgs(argc, argv);
+  std::string kind = cfg.Get("rabit_engine", "auto");
+  if (kind == "auto" || kind == "native") {
+    // TODO(robust): default distributed mode flips to "robust" once the
+    // recovery protocol lands.
+    kind = cfg.Get("rabit_tracker_uri", "NULL") == "NULL" ? "empty" : "base";
+  }
+  if (kind == "empty") {
+    g_engine = std::make_unique<EmptyEngine>();
+  } else if (kind == "base") {
+    g_engine = std::make_unique<BaseEngine>();
+  } else if (kind == "robust") {
+    g_engine = CreateRobustEngine();
+  } else if (kind == "mock") {
+    g_engine = CreateMockEngine();
+  } else {
+    throw Error(Format("unknown rabit_engine '%s'", kind.c_str()));
+  }
+  g_engine->Init(cfg);
+}
+
+void FinalizeEngine() {
+  if (g_engine != nullptr) {
+    g_engine->Shutdown();
+    g_engine.reset();
+  }
+}
+
+}  // namespace tpurabit

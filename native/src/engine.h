@@ -1,0 +1,188 @@
+// Engine interface + base (non-fault-tolerant) engine.
+//
+// Capability parity with the reference's IEngine seam
+// (/root/reference/include/rabit/internal/engine.h:32-209) and engine
+// singleton (src/engine.cc), with run-time backend selection
+// (rabit_engine=empty|base|robust|mock) instead of link-time macros.
+#pragma once
+
+#include <cstdint>
+#include <functional>
+#include <memory>
+#include <string>
+
+#include "comm.h"
+#include "common.h"
+
+namespace tpurabit {
+
+// ABI enums shared with the Python binding (and matching the reference's
+// c_api dtype/op numbering, python/rabit.py:83-86 + :209-218).
+enum DataType : int {
+  kInt8 = 0, kUInt8 = 1, kInt32 = 2, kUInt32 = 3,
+  kInt64 = 4, kUInt64 = 5, kFloat32 = 6, kFloat64 = 7,
+};
+enum OpType : int { kMax = 0, kMin = 1, kSum = 2, kBitOr = 3 };
+
+size_t DTypeSize(int dtype);
+ReduceFn BuiltinReducer(int op, int dtype);  // nullptr if unsupported
+
+using PrepareFn = void (*)(void* arg);
+
+class Engine {
+ public:
+  virtual ~Engine() = default;
+  virtual void Init(const Config& cfg) = 0;
+  virtual void Shutdown() = 0;
+
+  virtual int rank() const = 0;
+  virtual int world() const = 0;
+  virtual bool distributed() const = 0;
+  virtual int ring_prev() const = 0;
+  virtual std::string host() const = 0;
+  virtual void TrackerPrint(const std::string& msg) = 0;
+
+  // prepare_fn (may be null) runs right before the reduction unless the
+  // result is served from recovery replay (lazy-prepare contract,
+  // reference rabit.h:182-206).  cache_key is the caller-site key for the
+  // bootstrap cache (reference rabit.h:29-37).
+  virtual void Allreduce(void* buf, size_t elem_size, size_t count,
+                         ReduceFn fn, void* fn_ctx, PrepareFn prepare_fn,
+                         void* prepare_arg, const char* cache_key) = 0;
+  virtual void Broadcast(void* buf, size_t size, int root,
+                         const char* cache_key) = 0;
+  // Rank-ordered concatenation of per-rank slices; my slice is
+  // [slice_begin, slice_end) of `buf` (total_bytes long).
+  virtual void Allgather(void* buf, size_t total_bytes, size_t slice_begin,
+                         size_t slice_end, const char* cache_key) = 0;
+
+  virtual int LoadCheckPoint(std::string* global_blob,
+                             std::string* local_blob) = 0;
+  virtual void CheckPoint(const char* gdata, size_t glen, const char* ldata,
+                          size_t llen) = 0;
+  // Stores only the pointer; caller keeps the buffer alive and unchanged
+  // until the next checkpoint (reference LazyCheckPoint contract,
+  // rabit.h:311-332).
+  virtual void LazyCheckPoint(const char* gdata, size_t glen) = 0;
+  virtual int VersionNumber() const = 0;
+  virtual void InitAfterException() = 0;
+};
+
+// Solo no-op engine (reference: src/engine_empty.cc) with in-memory
+// versioned checkpoints so the full API works single-process.
+class EmptyEngine : public Engine {
+ public:
+  void Init(const Config&) override {}
+  void Shutdown() override {}
+  int rank() const override { return 0; }
+  int world() const override { return 1; }
+  bool distributed() const override { return false; }
+  int ring_prev() const override { return 0; }
+  std::string host() const override {
+    char b[256];
+    gethostname(b, sizeof(b));
+    return b;
+  }
+  void TrackerPrint(const std::string& msg) override {
+    fprintf(stdout, "%s\n", msg.c_str());
+    fflush(stdout);
+  }
+  void Allreduce(void*, size_t, size_t, ReduceFn, void*, PrepareFn prepare_fn,
+                 void* prepare_arg, const char*) override {
+    if (prepare_fn != nullptr) prepare_fn(prepare_arg);
+  }
+  void Broadcast(void*, size_t, int root, const char*) override {
+    TRT_CHECK(root == 0, "broadcast root %d out of range for world 1", root);
+  }
+  void Allgather(void*, size_t, size_t, size_t, const char*) override {}
+  int LoadCheckPoint(std::string* g, std::string* l) override {
+    if (version_ > 0) {
+      *g = global_;
+      *l = local_;
+    }
+    return version_;
+  }
+  void CheckPoint(const char* gd, size_t gl, const char* ld, size_t ll) override {
+    global_.assign(gd, gd + gl);
+    local_ = ld != nullptr ? std::string(ld, ld + ll) : std::string();
+    ++version_;
+  }
+  void LazyCheckPoint(const char* gd, size_t gl) override {
+    CheckPoint(gd, gl, nullptr, 0);
+  }
+  int VersionNumber() const override { return version_; }
+  void InitAfterException() override {
+    throw Error("empty engine cannot recover from exceptions");
+  }
+
+ private:
+  int version_ = 0;
+  std::string global_, local_;
+};
+
+// Tree/ring collectives over TCP, no fault tolerance: a peer failure is a
+// hard error (reference: AllreduceBase).
+class BaseEngine : public Engine {
+ public:
+  void Init(const Config& cfg) override {
+    comm_.Configure(cfg);
+    comm_.Init(/*recover=*/false);
+  }
+  void Shutdown() override { comm_.Shutdown(); }
+  int rank() const override { return comm_.rank(); }
+  int world() const override { return comm_.world(); }
+  bool distributed() const override { return comm_.distributed(); }
+  int ring_prev() const override { return comm_.ring_prev(); }
+  std::string host() const override { return comm_.host(); }
+  void TrackerPrint(const std::string& msg) override { comm_.TrackerPrint(msg); }
+
+  void Allreduce(void* buf, size_t elem_size, size_t count, ReduceFn fn,
+                 void* fn_ctx, PrepareFn prepare_fn, void* prepare_arg,
+                 const char*) override {
+    if (prepare_fn != nullptr) prepare_fn(prepare_arg);
+    Must(comm_.Allreduce(buf, elem_size, count, fn, fn_ctx), "allreduce");
+  }
+  void Broadcast(void* buf, size_t size, int root, const char*) override {
+    Must(comm_.Broadcast(buf, size, root), "broadcast");
+  }
+  void Allgather(void* buf, size_t total, size_t beg, size_t end,
+                 const char*) override;
+
+  int LoadCheckPoint(std::string* g, std::string* l) override {
+    if (version_ > 0) {
+      *g = global_;
+      *l = local_;
+    }
+    return version_;
+  }
+  void CheckPoint(const char* gd, size_t gl, const char* ld, size_t ll) override {
+    global_.assign(gd, gd + gl);
+    local_ = ld != nullptr ? std::string(ld, ld + ll) : std::string();
+    ++version_;
+  }
+  void LazyCheckPoint(const char* gd, size_t gl) override {
+    CheckPoint(gd, gl, nullptr, 0);
+  }
+  int VersionNumber() const override { return version_; }
+  void InitAfterException() override {
+    throw Error("base engine cannot recover; use the robust engine");
+  }
+
+ protected:
+  void Must(IoResult r, const char* what) {
+    TRT_CHECK(r == IoResult::kOk,
+              "[rank %d] peer failure during %s: the base engine is not "
+              "fault-tolerant", comm_.rank(), what);
+  }
+  Comm comm_;
+  int version_ = 0;
+  std::string global_, local_;
+};
+
+// Process-wide engine singleton (the reference keeps one per thread,
+// engine.cc:30-52; the engine API is not thread-safe either way).
+Engine* GetEngine();
+void InitEngine(int argc, char** argv);
+void FinalizeEngine();
+
+}  // namespace tpurabit

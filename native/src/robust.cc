@@ -1,0 +1,15 @@
+// Robust (fault-tolerant) engine — placeholder until the recovery protocol
+// lands; the factory seam exists so engine.cc links.
+#include "engine.h"
+
+namespace tpurabit {
+
+std::unique_ptr<Engine> CreateRobustEngine() {
+  throw Error("robust engine not built yet; use rabit_engine=base");
+}
+
+std::unique_ptr<Engine> CreateMockEngine() {
+  throw Error("mock engine not built yet; use rabit_engine=base");
+}
+
+}  // namespace tpurabit

@@ -1,0 +1,41 @@
+#include "socket.h"
+
+#include <vector>
+
+namespace tpurabit {
+
+IoResult DriveTransfers(Transfer* transfers, int n, int timeout_ms) {
+  // Initial eager pass: most small transfers complete without polling.
+  for (int i = 0; i < n; ++i) {
+    if (!transfers[i].Finished() && !transfers[i].Step()) {
+      return IoResult::kPeerFailure;
+    }
+  }
+  std::vector<pollfd> pfds;
+  for (;;) {
+    pfds.clear();
+    for (int i = 0; i < n; ++i) {
+      Transfer& t = transfers[i];
+      if (t.Finished()) continue;
+      pollfd p{};
+      p.fd = t.fd;
+      p.events = t.sending ? POLLOUT : POLLIN;
+      pfds.push_back(p);
+    }
+    if (pfds.empty()) return IoResult::kOk;
+    int rc = ::poll(pfds.data(), pfds.size(), timeout_ms);
+    if (rc < 0) {
+      if (errno == EINTR) continue;
+      throw Error(Format("poll failed: %s", strerror(errno)));
+    }
+    if (rc == 0) throw Error("poll timeout on link transfer");
+    for (int i = 0; i < n; ++i) {
+      Transfer& t = transfers[i];
+      if (t.Finished()) continue;
+      // POLLERR/POLLHUP surface as recv/send errors inside Step().
+      if (!t.Step()) return IoResult::kPeerFailure;
+    }
+  }
+}
+
+}  // namespace tpurabit

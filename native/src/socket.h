@@ -1,0 +1,195 @@
+// TCP socket layer: RAII sockets, nonblocking progress helpers, full-duplex
+// exchange.
+//
+// Capability parity with the reference's socket.h (TCPSocket/PollHelper,
+// /root/reference/include/rabit/internal/socket.h:102-533) with a different
+// design: every data-plane fd is permanently nonblocking and all transfers
+// go through poll-driven progress loops that return a tri-state
+// (ok / peer-failure / fatal) instead of the reference's errno mapping at
+// each call site.  Peer failure (reset/EOF) is a *value*, not an exception,
+// so the robust layer can react; programming errors throw.
+#pragma once
+
+#include <arpa/inet.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstdint>
+#include <string>
+#include <utility>
+
+#include "common.h"
+
+namespace tpurabit {
+
+// Result of a transfer attempt on a link.
+enum class IoResult { kOk, kPeerFailure };
+
+class TcpSocket {
+ public:
+  TcpSocket() = default;
+  explicit TcpSocket(int fd) : fd_(fd) {}
+  ~TcpSocket() { Close(); }
+  TcpSocket(const TcpSocket&) = delete;
+  TcpSocket& operator=(const TcpSocket&) = delete;
+  TcpSocket(TcpSocket&& o) noexcept : fd_(o.fd_) { o.fd_ = -1; }
+  TcpSocket& operator=(TcpSocket&& o) noexcept {
+    if (this != &o) { Close(); fd_ = o.fd_; o.fd_ = -1; }
+    return *this;
+  }
+
+  int fd() const { return fd_; }
+  bool valid() const { return fd_ >= 0; }
+
+  void Create() {
+    Close();
+    fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    TRT_CHECK(fd_ >= 0, "socket() failed: %s", strerror(errno));
+  }
+
+  void Close() {
+    if (fd_ >= 0) { ::close(fd_); fd_ = -1; }
+  }
+
+  void SetNonBlock(bool on) {
+    int flags = fcntl(fd_, F_GETFL, 0);
+    TRT_CHECK(flags >= 0, "fcntl GETFL: %s", strerror(errno));
+    flags = on ? (flags | O_NONBLOCK) : (flags & ~O_NONBLOCK);
+    TRT_CHECK(fcntl(fd_, F_SETFL, flags) == 0, "fcntl SETFL: %s", strerror(errno));
+  }
+
+  void SetNoDelay(bool on) {
+    int v = on ? 1 : 0;
+    setsockopt(fd_, IPPROTO_TCP, TCP_NODELAY, &v, sizeof(v));
+  }
+
+  void SetKeepAlive(bool on) {
+    int v = on ? 1 : 0;
+    setsockopt(fd_, SOL_SOCKET, SO_KEEPALIVE, &v, sizeof(v));
+  }
+
+  void SetReuseAddr() {
+    int v = 1;
+    setsockopt(fd_, SOL_SOCKET, SO_REUSEADDR, &v, sizeof(v));
+  }
+
+  // Bind to any free port (or `port` if nonzero); returns bound port.
+  int BindListen(int port = 0, int backlog = 128) {
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_ANY);
+    addr.sin_port = htons(static_cast<uint16_t>(port));
+    SetReuseAddr();
+    TRT_CHECK(::bind(fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) == 0,
+              "bind failed: %s", strerror(errno));
+    TRT_CHECK(::listen(fd_, backlog) == 0, "listen failed: %s", strerror(errno));
+    socklen_t len = sizeof(addr);
+    TRT_CHECK(getsockname(fd_, reinterpret_cast<sockaddr*>(&addr), &len) == 0,
+              "getsockname: %s", strerror(errno));
+    return ntohs(addr.sin_port);
+  }
+
+  TcpSocket Accept() {
+    int cfd = ::accept(fd_, nullptr, nullptr);
+    TRT_CHECK(cfd >= 0, "accept failed: %s", strerror(errno));
+    return TcpSocket(cfd);
+  }
+
+  void Connect(const std::string& host, int port, int retries = 5) {
+    for (int attempt = 0;; ++attempt) {
+      Create();
+      sockaddr_in addr{};
+      addr.sin_family = AF_INET;
+      addr.sin_port = htons(static_cast<uint16_t>(port));
+      hostent* he = gethostbyname(host.c_str());
+      TRT_CHECK(he != nullptr, "cannot resolve host %s", host.c_str());
+      memcpy(&addr.sin_addr, he->h_addr_list[0], he->h_length);
+      if (::connect(fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) == 0) {
+        return;
+      }
+      Close();
+      TRT_CHECK(attempt < retries, "connect to %s:%d failed: %s", host.c_str(),
+                port, strerror(errno));
+      usleep(100000u << (attempt < 4 ? attempt : 4));  // capped backoff
+    }
+  }
+
+  // --- blocking helpers (bootstrap/tracker only; data links use the
+  //     nonblocking progress API below) ---
+
+  void SendAll(const void* data, size_t n) {
+    const char* p = static_cast<const char*>(data);
+    while (n > 0) {
+      ssize_t k = ::send(fd_, p, n, MSG_NOSIGNAL);
+      if (k < 0 && errno == EINTR) continue;
+      TRT_CHECK(k > 0, "send failed: %s", strerror(errno));
+      p += k;
+      n -= static_cast<size_t>(k);
+    }
+  }
+
+  void RecvAll(void* data, size_t n) {
+    char* p = static_cast<char*>(data);
+    while (n > 0) {
+      ssize_t k = ::recv(fd_, p, n, 0);
+      if (k < 0 && errno == EINTR) continue;
+      TRT_CHECK(k > 0, "recv failed: %s",
+                k == 0 ? "peer closed" : strerror(errno));
+      p += k;
+      n -= static_cast<size_t>(k);
+    }
+  }
+
+ private:
+  int fd_ = -1;
+};
+
+inline bool IsPeerFailureErrno(int err) {
+  return err == ECONNRESET || err == EPIPE || err == ECONNREFUSED ||
+         err == ETIMEDOUT || err == EHOSTUNREACH || err == ENOTCONN;
+}
+
+// Progress cursor over a buffer being sent or received on a nonblocking fd.
+struct Transfer {
+  int fd = -1;
+  char* buf = nullptr;
+  size_t size = 0;
+  size_t done = 0;
+  bool sending = false;
+  bool failed = false;
+
+  bool Finished() const { return failed || done >= size; }
+
+  // Attempt progress; returns false on peer failure (recorded in `failed`).
+  bool Step() {
+    while (done < size) {
+      ssize_t k = sending ? ::send(fd, buf + done, size - done, MSG_NOSIGNAL)
+                          : ::recv(fd, buf + done, size - done, 0);
+      if (k > 0) {
+        done += static_cast<size_t>(k);
+        continue;
+      }
+      if (k == 0 && !sending) { failed = true; return false; }  // EOF
+      if (k < 0) {
+        if (errno == EAGAIN || errno == EWOULDBLOCK) return true;
+        if (errno == EINTR) continue;
+        if (IsPeerFailureErrno(errno)) { failed = true; return false; }
+        throw Error(Format("link io error: %s", strerror(errno)));
+      }
+    }
+    return true;
+  }
+};
+
+// Drive a set of transfers to completion with poll(2); returns kPeerFailure
+// if ANY transfer hit a dead peer (remaining progress is abandoned — the
+// caller is about to tear down links anyway).
+IoResult DriveTransfers(Transfer* transfers, int n, int timeout_ms = -1);
+
+}  // namespace tpurabit

@@ -1,0 +1,15 @@
+"""Tracker: bootstrap/topology service + local cluster launcher."""
+
+from rabit_tpu.tracker.tracker import Tracker
+
+__all__ = ["Tracker", "LocalCluster"]
+
+
+def __getattr__(name):
+    # Lazy so `python -m rabit_tpu.tracker.launcher` doesn't double-import
+    # the launcher module (runpy warning).
+    if name == "LocalCluster":
+        from rabit_tpu.tracker.launcher import LocalCluster
+
+        return LocalCluster
+    raise AttributeError(name)

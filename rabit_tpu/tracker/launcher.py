@@ -1,0 +1,119 @@
+"""Local cluster launcher — the cluster-in-a-box test harness.
+
+Capability parity with ``dmlc-submit --cluster local --num-workers N
+--local-num-attempt R`` (reference test harness, test/test.mk:14-38): runs
+the tracker in-process, spawns N copies of a worker command as local
+processes with the tracker's address in their environment, and restarts any
+worker that dies (nonzero exit) up to ``max_restarts`` times — which is how
+multi-node fault tolerance is tested on one machine.
+
+Usage:
+    python -m rabit_tpu.tracker.launcher --num-workers 4 \
+        [--max-restarts 20] -- python worker_prog.py [args...]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+import time
+
+from rabit_tpu.tracker.tracker import Tracker
+
+
+class LocalCluster:
+    def __init__(
+        self,
+        num_workers: int,
+        max_restarts: int = 0,
+        quiet: bool = False,
+        extra_env: dict[str, str] | None = None,
+    ):
+        self.num_workers = num_workers
+        self.max_restarts = max_restarts
+        self.quiet = quiet
+        self.extra_env = extra_env or {}
+        self.restarts = [0] * num_workers
+        self.returncodes: list[int | None] = [None] * num_workers
+
+    def _spawn(self, cmd: list[str], tracker: Tracker, i: int) -> subprocess.Popen:
+        env = dict(os.environ)
+        env.update(self.extra_env)
+        env.update(
+            DMLC_TRACKER_URI=tracker.host,
+            DMLC_TRACKER_PORT=str(tracker.port),
+            DMLC_TASK_ID=str(i),
+            DMLC_NUM_ATTEMPT=str(self.restarts[i]),
+        )
+        return subprocess.Popen(cmd, env=env)
+
+    def run(self, cmd: list[str], timeout: float = 300.0) -> int:
+        """Run ``cmd`` x num_workers under a fresh tracker.  Returns 0 when
+        every worker exited cleanly; raises on restart-budget exhaustion or
+        timeout."""
+        tracker = Tracker(self.num_workers, quiet=self.quiet).start()
+        procs = [self._spawn(cmd, tracker, i) for i in range(self.num_workers)]
+        deadline = time.monotonic() + timeout
+        try:
+            while True:
+                if time.monotonic() > deadline:
+                    raise TimeoutError(f"cluster did not finish within {timeout}s")
+                alive = 0
+                for i, proc in enumerate(procs):
+                    if proc is None:
+                        continue
+                    ret = proc.poll()
+                    if ret is None:
+                        alive += 1
+                    elif ret == 0:
+                        self.returncodes[i] = 0
+                        procs[i] = None
+                    else:
+                        # Worker died: the reference tracker restarts it and
+                        # peers recover (doc/guide.md:338-374).
+                        if self.restarts[i] >= self.max_restarts:
+                            raise RuntimeError(
+                                f"worker {i} died with code {ret}; restart "
+                                f"budget ({self.max_restarts}) exhausted"
+                            )
+                        self.restarts[i] += 1
+                        if not self.quiet:
+                            print(
+                                f"[launcher] worker {i} died (code {ret}); "
+                                f"restart {self.restarts[i]}/{self.max_restarts}",
+                                flush=True,
+                            )
+                        procs[i] = self._spawn(cmd, tracker, i)
+                        alive += 1
+                if alive == 0:
+                    return 0
+                time.sleep(0.02)
+        finally:
+            for proc in procs:
+                if proc is not None and proc.poll() is None:
+                    proc.kill()
+                    proc.wait()
+            tracker.stop()
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--num-workers", "-n", type=int, required=True)
+    ap.add_argument("--max-restarts", type=int, default=0)
+    ap.add_argument("--timeout", type=float, default=300.0)
+    ap.add_argument("--quiet", action="store_true")
+    ap.add_argument("cmd", nargs=argparse.REMAINDER)
+    args = ap.parse_args(argv)
+    cmd = args.cmd
+    if cmd and cmd[0] == "--":
+        cmd = cmd[1:]
+    if not cmd:
+        ap.error("worker command required after --")
+    cluster = LocalCluster(args.num_workers, args.max_restarts, quiet=args.quiet)
+    return cluster.run(cmd, timeout=args.timeout)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,166 @@
+"""Tracker wire protocol — framed binary, little-endian.
+
+The reference outsources its tracker to dmlc-core and speaks an ad-hoc
+magic/struct protocol (worker side: ConnectTracker/ReConnectLinks,
+/root/reference/src/allreduce_base.cc:221-438).  This framework owns both
+ends, so the protocol is redesigned: one request/assignment round-trip per
+(re)bootstrap wave instead of the reference's incremental link-repair loop
+— every worker learns the full peer table and connects deterministically
+(lower rank dials, higher rank accepts).
+
+Message layout (all u32/i32 little-endian; strings are u32 length + utf-8):
+
+worker -> tracker (fresh connection per message):
+    u32 MAGIC_HELLO
+    u32 cmd          (CMD_START | CMD_RECOVER | CMD_PRINT | CMD_SHUTDOWN)
+    i32 prev_rank    (-1 if never assigned; stable re-admission key is task_id)
+    str task_id
+    if start/recover: u32 listen_port   (worker binds BEFORE contacting tracker)
+    if print:         str message
+
+tracker -> worker (start/recover reply, sent when the wave of world_size
+workers is complete):
+    u32 MAGIC_ASSIGN
+    i32 rank
+    u32 world_size
+    i32 parent       (-1 for root)
+    u32 nchildren, i32 children...
+    i32 ring_prev, i32 ring_next
+    u32 npeers, each: i32 rank, str host, u32 port
+    u32 epoch        (bootstrap wave number; stamps peer-link handshakes)
+
+tracker -> worker (print/shutdown reply): u32 ACK
+
+worker <-> worker link handshake (both directions on connect/accept):
+    u32 MAGIC_LINK, i32 my_rank, u32 epoch
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+from dataclasses import dataclass, field
+
+MAGIC_HELLO = 0x7AB17001
+MAGIC_ASSIGN = 0x7AB17002
+MAGIC_LINK = 0x7AB17003
+ACK = 0
+
+CMD_START = 1
+CMD_RECOVER = 2
+CMD_PRINT = 3
+CMD_SHUTDOWN = 4
+
+_U32 = struct.Struct("<I")
+_I32 = struct.Struct("<i")
+
+
+def send_all(sock: socket.socket, data: bytes) -> None:
+    sock.sendall(data)
+
+
+def recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed connection")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def put_u32(v: int) -> bytes:
+    return _U32.pack(v)
+
+
+def put_i32(v: int) -> bytes:
+    return _I32.pack(v)
+
+
+def put_str(s: str) -> bytes:
+    raw = s.encode()
+    return _U32.pack(len(raw)) + raw
+
+
+def get_u32(sock) -> int:
+    return _U32.unpack(recv_exact(sock, 4))[0]
+
+
+def get_i32(sock) -> int:
+    return _I32.unpack(recv_exact(sock, 4))[0]
+
+
+def get_str(sock) -> str:
+    n = get_u32(sock)
+    return recv_exact(sock, n).decode() if n else ""
+
+
+@dataclass
+class Assignment:
+    rank: int
+    world_size: int
+    parent: int
+    children: list[int]
+    ring_prev: int
+    ring_next: int
+    peers: dict[int, tuple[str, int]] = field(default_factory=dict)
+    epoch: int = 0
+
+    def encode(self) -> bytes:
+        out = [
+            put_u32(MAGIC_ASSIGN),
+            put_i32(self.rank),
+            put_u32(self.world_size),
+            put_i32(self.parent),
+            put_u32(len(self.children)),
+        ]
+        out += [put_i32(c) for c in self.children]
+        out += [put_i32(self.ring_prev), put_i32(self.ring_next)]
+        out.append(put_u32(len(self.peers)))
+        for r, (host, port) in sorted(self.peers.items()):
+            out += [put_i32(r), put_str(host), put_u32(port)]
+        out.append(put_u32(self.epoch))
+        return b"".join(out)
+
+    @classmethod
+    def recv(cls, sock) -> "Assignment":
+        magic = get_u32(sock)
+        if magic != MAGIC_ASSIGN:
+            raise ValueError(f"bad assignment magic {magic:#x}")
+        rank = get_i32(sock)
+        world = get_u32(sock)
+        parent = get_i32(sock)
+        children = [get_i32(sock) for _ in range(get_u32(sock))]
+        ring_prev = get_i32(sock)
+        ring_next = get_i32(sock)
+        peers = {}
+        for _ in range(get_u32(sock)):
+            r = get_i32(sock)
+            host = get_str(sock)
+            port = get_u32(sock)
+            peers[r] = (host, port)
+        epoch = get_u32(sock)
+        return cls(rank, world, parent, children, ring_prev, ring_next, peers, epoch)
+
+
+def tree_topology(rank: int, world: int) -> tuple[int, list[int]]:
+    """Balanced binary heap tree: parent (r-1)//2, children 2r+1 / 2r+2."""
+    parent = (rank - 1) // 2 if rank > 0 else -1
+    children = [c for c in (2 * rank + 1, 2 * rank + 2) if c < world]
+    return parent, children
+
+
+def send_hello(
+    sock,
+    cmd: int,
+    task_id: str,
+    prev_rank: int = -1,
+    listen_port: int = 0,
+    message: str = "",
+) -> None:
+    out = [put_u32(MAGIC_HELLO), put_u32(cmd), put_i32(prev_rank), put_str(task_id)]
+    if cmd in (CMD_START, CMD_RECOVER):
+        out.append(put_u32(listen_port))
+    elif cmd == CMD_PRINT:
+        out.append(put_str(message))
+    send_all(sock, b"".join(out))

@@ -1,0 +1,166 @@
+"""The tracker: rank assignment, topology, bootstrap waves, worker restart
+coordination.
+
+Capability parity with dmlc-core's tracker (the piece the reference
+outsources — SURVEY.md C18): it launches nothing itself (see launcher.py);
+it accepts worker check-ins, assigns stable ranks keyed by task id, builds
+the reduction tree + ring, hands every worker the full peer table, and
+funnels worker ``print``/``shutdown`` messages.  Recovery is wave-based: a
+worker death cascades into every survivor reconnecting with ``recover``
+while the launcher restarts the dead one with ``start``; once world_size
+check-ins are pending, the tracker broadcasts a fresh assignment with a
+bumped epoch.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+from dataclasses import dataclass
+
+from rabit_tpu.tracker import protocol as P
+
+
+@dataclass
+class _Pending:
+    conn: socket.socket
+    task_id: str
+    listen_port: int
+    host: str
+    prev_rank: int
+
+
+class Tracker:
+    def __init__(self, world_size: int, host: str = "127.0.0.1", port: int = 0,
+                 quiet: bool = False):
+        self.world_size = world_size
+        self.quiet = quiet
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind((host, port))
+        self._srv.listen(256)
+        self.host, self.port = self._srv.getsockname()
+        self._lock = threading.Lock()
+        self._pending: list[_Pending] = []
+        self._ranks: dict[str, int] = {}  # task_id -> stable rank
+        self._epoch = 0
+        self._n_shutdown = 0
+        self._done = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.messages: list[str] = []  # worker print log (also echoed)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "Tracker":
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+        return self
+
+    def wait(self, timeout: float | None = None) -> bool:
+        return self._done.wait(timeout)
+
+    def stop(self) -> None:
+        self._done.set()
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+
+    # -- serving -----------------------------------------------------------
+
+    def _serve(self) -> None:
+        while not self._done.is_set():
+            try:
+                conn, addr = self._srv.accept()
+            except OSError:
+                break
+            threading.Thread(
+                target=self._handle, args=(conn, addr), daemon=True
+            ).start()
+
+    def _handle(self, conn: socket.socket, addr) -> None:
+        try:
+            magic = P.get_u32(conn)
+            if magic != P.MAGIC_HELLO:
+                conn.close()
+                return
+            cmd = P.get_u32(conn)
+            prev_rank = P.get_i32(conn)
+            task_id = P.get_str(conn)
+            if cmd in (P.CMD_START, P.CMD_RECOVER):
+                listen_port = P.get_u32(conn)
+                self._register(conn, addr[0], task_id, listen_port, prev_rank)
+                # conn is answered (and closed) by the wave completer.
+                return
+            if cmd == P.CMD_PRINT:
+                msg = P.get_str(conn)
+                self.messages.append(msg)
+                if not self.quiet:
+                    print(msg, end="" if msg.endswith("\n") else "\n", flush=True)
+                conn.sendall(P.put_u32(P.ACK))
+            elif cmd == P.CMD_SHUTDOWN:
+                conn.sendall(P.put_u32(P.ACK))
+                with self._lock:
+                    self._n_shutdown += 1
+                    if self._n_shutdown >= self.world_size:
+                        self._done.set()
+            conn.close()
+        except (ConnectionError, OSError, ValueError):
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _register(self, conn, host, task_id, listen_port, prev_rank) -> None:
+        with self._lock:
+            # A re-check-in from the same task id replaces its stale entry
+            # (e.g. worker retried while the wave was still filling).
+            for stale in (p for p in self._pending if p.task_id == task_id):
+                try:
+                    stale.conn.close()
+                except OSError:
+                    pass
+            self._pending = [p for p in self._pending if p.task_id != task_id]
+            self._pending.append(_Pending(conn, task_id, listen_port, host, prev_rank))
+            if len(self._pending) < self.world_size:
+                return
+            wave, self._pending = self._pending, []
+            epoch = self._epoch
+            self._epoch += 1
+        self._assign_and_send(wave, epoch)
+
+    def _assign_and_send(self, wave: list[_Pending], epoch: int) -> None:
+        # Stable ranks: task ids seen before keep their rank (re-admission of
+        # a restarted worker, reference ReConnectLinks "recover"); new ids
+        # fill the free slots in check-in order.
+        taken = {self._ranks[p.task_id] for p in wave if p.task_id in self._ranks}
+        free = iter(r for r in range(self.world_size) if r not in taken)
+        for p in wave:
+            if p.task_id not in self._ranks:
+                self._ranks[p.task_id] = next(free)
+        peers = {
+            self._ranks[p.task_id]: (p.host, p.listen_port) for p in wave
+        }
+        n = self.world_size
+        for p in wave:
+            rank = self._ranks[p.task_id]
+            parent, children = P.tree_topology(rank, n)
+            asg = P.Assignment(
+                rank=rank,
+                world_size=n,
+                parent=parent,
+                children=children,
+                ring_prev=(rank - 1) % n,
+                ring_next=(rank + 1) % n,
+                peers=peers,
+                epoch=epoch,
+            )
+            try:
+                p.conn.sendall(asg.encode())
+            except OSError:
+                pass  # worker died mid-bootstrap; next wave will handle it
+            finally:
+                try:
+                    p.conn.close()
+                except OSError:
+                    pass

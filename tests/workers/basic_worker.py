@@ -1,0 +1,74 @@
+"""Self-verifying distributed worker — the reference's integration-test
+pattern (test/model_recover.cc: compute every reduction's expected value in
+closed form and check all elements; SURVEY.md section 4 tier 2).
+
+Runs under the local tracker with the native engine.  Exits nonzero on any
+mismatch so the launcher/test harness sees failures.
+"""
+
+import sys
+
+import numpy as np
+
+import rabit_tpu as rt
+
+
+def check(cond, msg):
+    if not cond:
+        print(f"[worker] CHECK FAILED: {msg}", file=sys.stderr, flush=True)
+        sys.exit(2)
+
+
+def main():
+    rt.init(rabit_engine="base")
+    rank = rt.get_rank()
+    world = rt.get_world_size()
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 1000
+
+    # allreduce MAX: worker r contributes i + r -> expect i + world - 1
+    x = np.arange(n, dtype=np.float32) + rank
+    out = rt.allreduce(x, rt.MAX)
+    check(np.array_equal(out, np.arange(n, dtype=np.float32) + world - 1),
+          "allreduce max")
+
+    # allreduce SUM: worker r contributes r + i
+    x = np.arange(n, dtype=np.float64) + rank
+    out = rt.allreduce(x, rt.SUM)
+    expect = world * np.arange(n, dtype=np.float64) + world * (world - 1) / 2
+    check(np.allclose(out, expect), "allreduce sum")
+
+    # allreduce MIN + BITOR
+    out = rt.allreduce(np.array([rank + 5], dtype=np.int32), rt.MIN)
+    check(out[0] == 5, "allreduce min")
+    out = rt.allreduce(np.array([1 << rank], dtype=np.uint32), rt.BITOR)
+    check(out[0] == (1 << world) - 1, "allreduce bitor")
+
+    # broadcast a python object from each root in turn
+    for root in range(world):
+        obj = {"root": root, "payload": list(range(root + 1))} if rank == root else None
+        got = rt.broadcast(obj, root)
+        check(got == {"root": root, "payload": list(range(root + 1))},
+              f"broadcast from {root}")
+
+    # allgather
+    got = rt.allgather(np.array([rank, rank * rank], dtype=np.int64))
+    expect = np.array([[r, r * r] for r in range(world)], dtype=np.int64)
+    check(np.array_equal(got, expect), "allgather")
+
+    # lazy prepare_fun contract
+    called = []
+
+    def prep(arr):
+        called.append(1)
+        arr[:] = rank
+
+    out = rt.allreduce(np.zeros(4, np.float32), rt.SUM, prepare_fun=prep)
+    check(called == [1], "prepare_fun called once")
+    check(np.allclose(out, world * (world - 1) / 2), "prepare_fun allreduce")
+
+    rt.tracker_print(f"worker {rank}/{world} ok\n")
+    rt.finalize()
+
+
+if __name__ == "__main__":
+    main()
