@@ -83,6 +83,15 @@ int RabitAllgather(void* sendrecv, trt_ulong total_bytes, trt_ulong slice_begin,
   });
 }
 
+int RabitAllgatherKeyed(void* sendrecv, trt_ulong total_bytes,
+                        trt_ulong slice_begin, trt_ulong slice_end,
+                        const char* cache_key) {
+  return Guard([&] {
+    GetEngine()->Allgather(sendrecv, total_bytes, slice_begin, slice_end,
+                           cache_key != nullptr ? cache_key : "");
+  });
+}
+
 int RabitAllreduce(void* buf, trt_ulong count, int dtype, int op,
                    void (*prepare_fn)(void*), void* prepare_arg) {
   return Guard([&] {

@@ -112,9 +112,9 @@ void InitEngine(int argc, char** argv) {
   cfg.LoadArgs(argc, argv);
   std::string kind = cfg.Get("rabit_engine", "auto");
   if (kind == "auto" || kind == "native") {
-    // TODO(robust): default distributed mode flips to "robust" once the
-    // recovery protocol lands.
-    kind = cfg.Get("rabit_tracker_uri", "NULL") == "NULL" ? "empty" : "base";
+    // Distributed default is the fault-tolerant engine, like the reference's
+    // default librabit link (engine.cc:19-27 RABIT_USE_* macros).
+    kind = cfg.Get("rabit_tracker_uri", "NULL") == "NULL" ? "empty" : "robust";
   }
   if (kind == "empty") {
     g_engine = std::make_unique<EmptyEngine>();

@@ -1,15 +1,935 @@
-// Robust (fault-tolerant) engine — placeholder until the recovery protocol
-// lands; the factory seam exists so engine.cc links.
+// Robust (fault-tolerant) engine + mock fault-injection engine.
+//
+// Capability parity with the reference's AllreduceRobust
+// (/root/reference/src/allreduce_robust.{h,cc}: versioned in-memory
+// checkpoints, op-result replay log with rotating replicas, consensus-driven
+// recovery of restarted workers, ring-replicated local checkpoints,
+// bootstrap cache, timeout watchdog) and AllreduceMock
+// (/root/reference/src/allreduce_mock.h: deterministic kill switch, per-op
+// stats, force_local) — with a redesigned recovery protocol:
+//
+//  * The reference compresses per-rank state into one allreduced
+//    ActionSummary (OR of flags / min of seqno, allreduce_robust.h:224-322)
+//    and then routes recovery data along the tree with two MsgPassing
+//    sweeps (TryDecideRouting/TryRecoverData).  Here every robust operation
+//    begins with a small ring allgather of the full per-rank PeerState
+//    table; every rank computes the same Decision from the same table, so
+//    serving degenerates to (elect owner -> broadcast) with no routing
+//    machinery and no special-case consensus flags.
+//  * The reference incrementally repairs surviving links
+//    (ReConnectLinks, allreduce_base.cc:263-438).  Here recovery
+//    re-bootstraps the whole mesh in a fresh tracker epoch (comm.h), which
+//    makes link state trivially consistent after any failure combination.
+//
+// The consensus round before every op is also what lets a restarted worker
+// catch up: survivors' rounds serve checkpoint blobs and replayed op results
+// until the whole world is at the same (version, seqno), then everyone runs
+// the op live together (the reference's "all-same-seqno & no flags => you
+// run it", allreduce_robust.cc:1299-1302).
+#include <unistd.h>
+
+#include <algorithm>
+#include <condition_variable>
+#include <cstring>
+#include <functional>
+#include <map>
+#include <mutex>
+#include <set>
+#include <string>
+#include <thread>
+#include <vector>
+
 #include "engine.h"
 
 namespace tpurabit {
 
+namespace {
+
+// Status/mode bits carried in PeerState.flags.
+constexpr uint32_t kStInLoadCheck = 1u << 0;   // blocked in LoadCheckPoint
+constexpr uint32_t kStInCheckPoint = 1u << 1;  // at checkpoint phase-1 barrier
+constexpr uint32_t kStInCheckAck = 1u << 2;    // at checkpoint phase-2 barrier
+constexpr uint32_t kStLoaded = 1u << 3;        // has completed LoadCheckPoint
+
+constexpr uint32_t kModeMask = kStInLoadCheck | kStInCheckPoint | kStInCheckAck;
+
+// One rank's consensus record.  Exchanged as raw little-endian bytes in a
+// ring allgather before every robust operation (the reference's
+// ActionSummary allreduce plays this role, allreduce_robust.cc:1176-1178).
+struct PeerState {
+  uint32_t flags = 0;
+  int32_t version = 0;
+  uint32_t seqno = 0;
+  int32_t nlocal = -1;  // num_local_replica once fixed, -1 before
+};
+static_assert(sizeof(PeerState) == 16, "PeerState must be packed");
+
+// What the table tells every rank to do next.  Computed identically on all
+// ranks from identical tables, so the sub-collectives below stay aligned.
+enum class Act {
+  kServeCkpt,      // someone is in LoadCheckPoint and a checkpoint exists
+  kFreshExit,      // loaders exit with version 0 (no checkpoint anywhere)
+  kServeBoot,      // a restarted worker needs a pre-LoadCheckPoint op result
+  kServeSeq,       // lowest-seqno ranks need a replayed op result
+  kProceedCkpt,    // all ranks at the checkpoint barrier: commit
+  kCommitRelease,  // peers already committed v+1: barrier ranks commit too
+  kAckRelease,     // phase-2 barrier resolved: ack ranks exit
+  kRunLive,        // world consistent: run the collective for real
+};
+
+// One-shot recovery watchdog (reference: allreduce_robust.cc:693-716 —
+// bounds hang time when a dead worker is never restarted).
+class Watchdog {
+ public:
+  ~Watchdog() { Disarm(); }
+
+  void Arm(double sec, int rank) {
+    if (sec <= 0 || armed_) return;
+    Disarm();
+    armed_ = true;
+    cancel_ = false;
+    thread_ = std::thread([this, sec, rank] {
+      std::unique_lock<std::mutex> lk(m_);
+      if (!cv_.wait_for(lk, std::chrono::duration<double>(sec),
+                        [this] { return cancel_; })) {
+        fprintf(stderr,
+                "[rank %d] fatal: recovery did not complete within %.0fs "
+                "(rabit_timeout_sec); aborting\n",
+                rank, sec);
+        _exit(10);
+      }
+    });
+  }
+
+  void Disarm() {
+    {
+      std::lock_guard<std::mutex> lk(m_);
+      cancel_ = true;
+    }
+    cv_.notify_all();
+    if (thread_.joinable()) thread_.join();
+    armed_ = false;
+  }
+
+ private:
+  std::mutex m_;
+  std::condition_variable cv_;
+  std::thread thread_;
+  bool cancel_ = false;
+  bool armed_ = false;
+};
+
+}  // namespace
+
+class RobustEngine : public Engine {
+ public:
+  void Init(const Config& cfg) override {
+    cfg_ = cfg;
+    comm_.Configure(cfg);
+    comm_.Init(/*recover=*/false);
+    num_global_replica_ =
+        std::max<int>(1, static_cast<int>(cfg.GetInt("rabit_global_replica", 5)));
+    local_replica_cfg_ =
+        std::max<int>(0, static_cast<int>(cfg.GetInt("rabit_local_replica", 2)));
+    boot_cache_on_ = cfg.GetBool("rabit_bootstrap_cache", false);
+    debug_ = cfg.GetBool("rabit_debug", false);
+    timeout_sec_ = cfg.GetBool("rabit_timeout", false)
+                       ? static_cast<double>(cfg.GetInt("rabit_timeout_sec", 1800))
+                       : 0.0;
+    result_round_ = std::max(comm_.world() / num_global_replica_, 1);
+  }
+
+  void Shutdown() override { comm_.Shutdown(); }
+
+  int rank() const override { return comm_.rank(); }
+  int world() const override { return comm_.world(); }
+  bool distributed() const override { return comm_.distributed(); }
+  int ring_prev() const override { return comm_.ring_prev(); }
+  std::string host() const override { return comm_.host(); }
+  void TrackerPrint(const std::string& msg) override { comm_.TrackerPrint(msg); }
+
+  // -- collectives ---------------------------------------------------------
+
+  void Allreduce(void* buf, size_t elem_size, size_t count, ReduceFn fn,
+                 void* fn_ctx, PrepareFn prepare_fn, void* prepare_arg,
+                 const char* cache_key) override {
+    if (!comm_.distributed()) {
+      if (prepare_fn != nullptr) prepare_fn(prepare_arg);
+      return;
+    }
+    double t0 = NowSec();
+    OpCtx op{static_cast<char*>(buf), elem_size * count, Key(cache_key)};
+    if (!RecoverExec(&op, 0)) {
+      // Lazy-prepare contract: skipped when the result was recovered
+      // (reference allreduce_robust.cc:275).
+      if (prepare_fn != nullptr) prepare_fn(prepare_arg);
+      RunLive(&op, [&](char* s) {
+        return comm_.Allreduce(s, elem_size, count, fn, fn_ctx);
+      });
+    }
+    LogOp("allreduce", op, t0);
+  }
+
+  void Broadcast(void* buf, size_t size, int root, const char* cache_key) override {
+    if (!comm_.distributed()) {
+      TRT_CHECK(root == 0, "broadcast root %d out of range for world 1", root);
+      return;
+    }
+    double t0 = NowSec();
+    OpCtx op{static_cast<char*>(buf), size, Key(cache_key)};
+    if (!RecoverExec(&op, 0)) {
+      RunLive(&op, [&](char* s) { return comm_.Broadcast(s, size, root); });
+    }
+    LogOp("broadcast", op, t0);
+  }
+
+  void Allgather(void* buf, size_t total, size_t beg, size_t end,
+                 const char* cache_key) override {
+    if (!comm_.distributed()) return;
+    double t0 = NowSec();
+    OpCtx op{static_cast<char*>(buf), total, Key(cache_key)};
+    if (!RecoverExec(&op, 0)) {
+      RunLive(&op, [&](char* s) {
+        std::vector<std::vector<char>> parts;
+        IoResult r = comm_.AllgatherV(s + beg, end - beg, &parts);
+        if (r != IoResult::kOk) return r;
+        size_t off = 0;
+        for (const auto& p : parts) {
+          TRT_CHECK(off + p.size() <= total, "allgather total size too small");
+          memcpy(s + off, p.data(), p.size());
+          off += p.size();
+        }
+        TRT_CHECK(off == total, "allgather size mismatch: %zu != %zu", off, total);
+        return IoResult::kOk;
+      });
+    }
+    LogOp("allgather", op, t0);
+  }
+
+  // -- checkpointing -------------------------------------------------------
+
+  int LoadCheckPoint(std::string* global_blob, std::string* local_blob) override {
+    if (!comm_.distributed()) {
+      if (version_ > 0) {
+        MaterializeGlobal();
+        *global_blob = global_ckpt_;
+        *local_blob = local_ckpt_;
+      }
+      loaded_ = true;
+      return version_;
+    }
+    RecoverExec(nullptr, kStInLoadCheck);
+    loaded_ = true;
+    seqno_ = 0;
+    resbuf_.clear();
+    if (version_ > 0) {
+      // Sync with the peers' phase-2 barrier before returning (reference
+      // LoadCheckPoint ends with a kCheckAck RecoverExec,
+      // allreduce_robust.cc:421-422): if the served checkpoint was the final
+      // one, peers blocked in their ack barrier must release before this
+      // process may run ahead (and possibly finalize).
+      RecoverExec(nullptr, kStInCheckAck);
+      MaterializeGlobal();
+      *global_blob = global_ckpt_;
+      *local_blob = local_ckpt_;
+    }
+    return version_;
+  }
+
+  void CheckPoint(const char* gdata, size_t glen, const char* ldata,
+                  size_t llen) override {
+    CheckPointImpl(gdata, glen, ldata, llen, /*lazy=*/false);
+  }
+
+  void LazyCheckPoint(const char* gdata, size_t glen) override {
+    CheckPointImpl(gdata, glen, nullptr, 0, /*lazy=*/true);
+  }
+
+  int VersionNumber() const override { return version_; }
+
+  void InitAfterException() override {
+    // The caller caught a failure exception (reference:
+    // IEngine::InitAfterException): rebuild the mesh; our CloseLinks
+    // cascades EOFs so every peer re-bootstraps too, then the app's
+    // LoadCheckPoint replays state.
+    CheckAndRecover();
+    watchdog_.Disarm();
+  }
+
+ protected:
+  // Per-operation context used by the recovery machinery to adopt a served
+  // result (the reference threads buf/size through RecoverExec the same way,
+  // allreduce_robust.cc:1158).
+  struct OpCtx {
+    char* buf;
+    size_t nbytes;
+    std::string key;   // caller-site bootstrap cache key ("" = none)
+    bool served = false;
+  };
+
+  std::string Key(const char* cache_key) const {
+    return cache_key != nullptr ? std::string(cache_key) : std::string();
+  }
+
+  void LogOp(const char* what, const OpCtx& op, double t0) {
+    if (debug_) {
+      fprintf(stderr, "[%d] %s (%s) finished version %d, seq %u, take %f s\n",
+              comm_.rank(), what, op.key.c_str(), version_, seqno_,
+              NowSec() - t0);
+    }
+  }
+
+  // --- failure handling ---------------------------------------------------
+
+  void CheckAndRecover() {
+    watchdog_.Arm(timeout_sec_, comm_.rank());
+    comm_.CloseLinks();
+    // Stagger tracker reconnects slightly (reference stampede control,
+    // allreduce_robust.cc:722).
+    usleep(1000u * static_cast<unsigned>(comm_.rank() % 32));
+    comm_.Init(/*recover=*/true);
+  }
+
+  // --- the consensus state machine ---------------------------------------
+
+  // Run consensus rounds until this rank's call is resolved.
+  //  mode == 0 (an op):     returns true if the result was served into
+  //                         op->buf (skip the live run), false for run-live.
+  //  mode == kStInLoadCheck:   returns true once the checkpoint (or fresh
+  //                            state) has been adopted.
+  //  mode == kStInCheckPoint:  returns true when all ranks reached the
+  //                            barrier (commit may proceed).
+  //  mode == kStInCheckAck:    returns true when the phase-2 barrier
+  //                            resolves.
+  bool RecoverExec(OpCtx* op, uint32_t mode) {
+    while (true) {
+      PeerState me;
+      me.flags = mode | (loaded_ ? kStLoaded : 0);
+      me.version = version_;
+      me.seqno = seqno_;
+      me.nlocal = num_local_replica_;
+      std::vector<PeerState> table(comm_.world());
+      if (comm_.Allgather(&me, sizeof(me), table.data()) != IoResult::kOk) {
+        CheckAndRecover();
+        continue;
+      }
+      Act act = Decide(table);
+      IoResult r = IoResult::kOk;
+      switch (act) {
+        case Act::kFreshExit:
+          if (mode == kStInLoadCheck) { watchdog_.Disarm(); return true; }
+          continue;
+        case Act::kServeCkpt:
+          r = ServeCheckpoint(table);
+          if (r == IoResult::kOk && mode == kStInLoadCheck) {
+            watchdog_.Disarm();
+            return true;
+          }
+          break;
+        case Act::kServeBoot:
+          r = ServeBootCache(table, op);
+          if (r == IoResult::kOk && op != nullptr && op->served) {
+            watchdog_.Disarm();
+            return true;
+          }
+          break;
+        case Act::kServeSeq:
+          r = ServeSeqno(table, op);
+          if (r == IoResult::kOk && op != nullptr && op->served) {
+            watchdog_.Disarm();
+            return true;
+          }
+          break;
+        case Act::kProceedCkpt:
+          TRT_CHECK(mode == kStInCheckPoint, "consensus desync at checkpoint");
+          watchdog_.Disarm();
+          return true;
+        case Act::kCommitRelease:
+          // Peers already committed this checkpoint; commit without
+          // re-replicating (replica coverage degrades until the next
+          // checkpoint re-replicates; committed peers do hold my blob).
+          if (mode == kStInCheckPoint) {
+            skip_replicate_ = true;
+            watchdog_.Disarm();
+            return true;
+          }
+          continue;
+        case Act::kAckRelease:
+          if (mode == kStInCheckAck) { watchdog_.Disarm(); return true; }
+          continue;
+        case Act::kRunLive:
+          TRT_CHECK(mode == 0,
+                    "collective mismatch: rank %d is in a %s while peers run "
+                    "data ops",
+                    comm_.rank(),
+                    mode == kStInLoadCheck ? "LoadCheckPoint" : "CheckPoint");
+          watchdog_.Disarm();
+          return false;
+      }
+      if (r != IoResult::kOk) CheckAndRecover();
+    }
+  }
+
+  Act Decide(const std::vector<PeerState>& table) const {
+    int maxv = 0;
+    bool any_loaded = false;
+    for (const auto& p : table) {
+      maxv = std::max(maxv, p.version);
+      if ((p.flags & kStLoaded) != 0) any_loaded = true;
+    }
+    bool any_loader = false, any_boot = false, any_ckpt = false, any_ack = false;
+    uint32_t min_seq = UINT32_MAX, max_seq = 0;
+    int min_ver = INT32_MAX, max_ver = 0;
+    for (const auto& p : table) {
+      uint32_t m = p.flags & kModeMask;
+      if (m == kStInLoadCheck) {
+        any_loader = true;
+        continue;  // loaders' version/seqno do not constrain the others
+      }
+      if ((p.flags & kStLoaded) == 0 && any_loaded) {
+        // A restarted worker running collectives before its LoadCheckPoint,
+        // in a world that is already past its own load: must be served from
+        // the bootstrap cache (reference README.md:25-28,
+        // allreduce_robust.cc:980-1024).  A whole-world cold start (nobody
+        // loaded) re-executes pre-load ops live instead.
+        any_boot = true;
+        continue;
+      }
+      if (m == kStInCheckPoint) any_ckpt = true;
+      if (m == kStInCheckAck) any_ack = true;
+      min_ver = std::min(min_ver, p.version);
+      max_ver = std::max(max_ver, p.version);
+      // Ack-barrier ranks only await version consistency; their (reset)
+      // seqno must not drag the spread down — a freshly served loader syncs
+      // through the ack barrier while peers are mid-op (see LoadCheckPoint).
+      if (m == kStInCheckAck) continue;
+      min_seq = std::min(min_seq, p.seqno);
+      max_seq = std::max(max_seq, p.seqno);
+    }
+    if (any_loader) return maxv == 0 ? Act::kFreshExit : Act::kServeCkpt;
+    if (any_boot) return Act::kServeBoot;
+    if (min_ver != INT32_MAX && min_ver != max_ver) {
+      // A failure can split a checkpoint commit: ranks whose barrier round
+      // (or local replication) completed commit v+1 and move to the ack
+      // barrier, while ranks that saw the failure retry the phase-1 barrier
+      // at v.  The commit globally happened — release the stragglers to
+      // commit too (the reference resolves the same window via the mixed
+      // kCheckPoint/kCheckAck ActionSummary flags,
+      // allreduce_robust.cc:1180-1196).
+      bool stragglers_ok = max_ver - min_ver == 1;
+      for (const auto& p : table) {
+        uint32_t m = p.flags & kModeMask;
+        if (m == kStInLoadCheck) continue;
+        if (p.version == min_ver && m != kStInCheckPoint) stragglers_ok = false;
+      }
+      TRT_CHECK(stragglers_ok,
+                "ranks disagree on checkpoint version (%d vs %d): a restarted "
+                "worker must call LoadCheckPoint before other collectives",
+                min_ver, max_ver);
+      return Act::kCommitRelease;
+    }
+    if (min_seq != UINT32_MAX && min_seq != max_seq) return Act::kServeSeq;
+    if (any_ack) return Act::kAckRelease;
+    if (any_ckpt) {
+      for (const auto& p : table) {
+        TRT_CHECK((p.flags & kModeMask) == kStInCheckPoint,
+                  "collective mismatch: some ranks checkpoint at seq %u while "
+                  "others still run ops",
+                  max_seq);
+      }
+      return Act::kProceedCkpt;
+    }
+    return Act::kRunLive;
+  }
+
+  // Elect the lowest rank reporting a nonzero vote; votes are (size+1) so
+  // zero means "don't have it".  Returns owner rank or -1.
+  IoResult Elect(uint64_t my_vote, int* owner, uint64_t* size) {
+    std::vector<uint64_t> votes(comm_.world(), 0);
+    IoResult r = comm_.Allgather(&my_vote, sizeof(my_vote), votes.data());
+    if (r != IoResult::kOk) return r;
+    *owner = -1;
+    for (int i = 0; i < comm_.world(); ++i) {
+      if (votes[i] != 0) {
+        *owner = i;
+        *size = votes[i] - 1;
+        break;
+      }
+    }
+    return IoResult::kOk;
+  }
+
+  // Serve the newest checkpoint (global + per-loader local blobs) to every
+  // rank blocked in LoadCheckPoint (reference TryLoadCheckPoint,
+  // allreduce_robust.cc:1037-1088).
+  IoResult ServeCheckpoint(const std::vector<PeerState>& table) {
+    const int n = comm_.world();
+    int maxv = 0;
+    for (const auto& p : table) maxv = std::max(maxv, p.version);
+    std::vector<int> loaders;
+    for (int i = 0; i < n; ++i) {
+      if ((table[i].flags & kModeMask) == kStInLoadCheck) loaders.push_back(i);
+    }
+    // Owner: lowest rank already at maxv, preferring ranks not themselves
+    // loading (an InitAfterException survivor may be both).
+    int owner = -1;
+    for (int pass = 0; pass < 2 && owner < 0; ++pass) {
+      for (int i = 0; i < n; ++i) {
+        bool is_loader = (table[i].flags & kModeMask) == kStInLoadCheck;
+        if (table[i].version == maxv && (pass == 1 || !is_loader)) {
+          owner = i;
+          break;
+        }
+      }
+    }
+    struct Hdr {
+      uint32_t version;
+      uint64_t glen;
+      int32_t nlocal;
+    } hdr{0, 0, -1};
+    if (comm_.rank() == owner) {
+      MaterializeGlobal();
+      hdr.version = static_cast<uint32_t>(version_);
+      hdr.glen = global_ckpt_.size();
+      hdr.nlocal = num_local_replica_;
+    }
+    IoResult r = comm_.Broadcast(&hdr, sizeof(hdr), owner);
+    if (r != IoResult::kOk) return r;
+    std::string blob(hdr.glen, '\0');
+    if (comm_.rank() == owner) blob = global_ckpt_;
+    r = comm_.Broadcast(blob.data(), blob.size(), owner);
+    if (r != IoResult::kOk) return r;
+    bool im_loader = std::find(loaders.begin(), loaders.end(), comm_.rank()) !=
+                     loaders.end();
+    if (im_loader) {
+      version_ = static_cast<int>(hdr.version);
+      global_ckpt_ = std::move(blob);
+      has_lazy_ = false;
+      num_local_replica_ = hdr.nlocal;
+    }
+    if (hdr.nlocal > 0) {
+      // Per-loader local blobs live on the loader's ring successors
+      // (reference local_chkpt ring replication, allreduce_robust.cc:1475).
+      for (int lr : loaders) {
+        uint64_t vote = 0;
+        auto it = local_replicas_.find(lr);
+        if (it != local_replicas_.end()) {
+          vote = it->second.size() + 1;
+        } else if (lr == comm_.rank() && !local_ckpt_.empty()) {
+          vote = local_ckpt_.size() + 1;
+        }
+        int lowner = -1;
+        uint64_t lsize = 0;
+        r = Elect(vote, &lowner, &lsize);
+        if (r != IoResult::kOk) return r;
+        TRT_CHECK(lowner >= 0,
+                  "local checkpoint of rank %d unrecoverable: all %d replicas "
+                  "died; raise rabit_local_replica",
+                  lr, hdr.nlocal);
+        std::string lblob(lsize, '\0');
+        if (comm_.rank() == lowner) {
+          lblob = (lr == comm_.rank() && local_replicas_.count(lr) == 0)
+                      ? local_ckpt_
+                      : local_replicas_[lr];
+        }
+        r = comm_.Broadcast(lblob.data(), lblob.size(), lowner);
+        if (r != IoResult::kOk) return r;
+        if (comm_.rank() == lr) local_ckpt_ = lblob;
+        // Re-seed the replica on every ring successor that should hold it —
+        // restarted successors lost theirs (the reference rebuilds replicas
+        // with bidirectional ring passes, TryRecoverLocalState).
+        for (int k = 1; k <= hdr.nlocal; ++k) {
+          if ((lr + k) % n == comm_.rank()) local_replicas_[lr] = lblob;
+        }
+      }
+    }
+    return IoResult::kOk;
+  }
+
+  // Serve pre-LoadCheckPoint op results by caller-site key (reference
+  // bootstrap cache, allreduce_robust.cc:100-154 + TryRestoreCache).
+  IoResult ServeBootCache(const std::vector<PeerState>& table, OpCtx* op) {
+    const int n = comm_.world();
+    std::vector<int> requesters;
+    for (int i = 0; i < n; ++i) {
+      uint32_t m = table[i].flags & kModeMask;
+      if ((table[i].flags & kStLoaded) == 0 && m != kStInLoadCheck) {
+        requesters.push_back(i);
+      }
+    }
+    bool im_requester =
+        std::find(requesters.begin(), requesters.end(), comm_.rank()) !=
+        requesters.end();
+    std::string my_key;
+    if (im_requester && op != nullptr && !op->key.empty()) {
+      my_key = BootKey(op->key);
+    }
+    std::vector<std::vector<char>> keys;
+    IoResult r = comm_.AllgatherV(my_key.data(), my_key.size(), &keys);
+    if (r != IoResult::kOk) return r;
+    for (int rr : requesters) {
+      std::string key(keys[rr].begin(), keys[rr].end());
+      TRT_CHECK(!key.empty(),
+                "rank %d replays a pre-LoadCheckPoint collective without a "
+                "cache key; pass cache keys and set rabit_bootstrap_cache=1",
+                rr);
+      auto it = boot_cache_.find(key);
+      uint64_t vote = it != boot_cache_.end() ? it->second.size() + 1 : 0;
+      int owner = -1;
+      uint64_t size = 0;
+      r = Elect(vote, &owner, &size);
+      if (r != IoResult::kOk) return r;
+      TRT_CHECK(owner >= 0,
+                "no peer holds bootstrap-cache entry '%s' (all workers must "
+                "run with rabit_bootstrap_cache=1 from the start for "
+                "pre-LoadCheckPoint replay)",
+                key.c_str());
+      std::string val(size, '\0');
+      if (comm_.rank() == owner) val = boot_cache_[key];
+      r = comm_.Broadcast(val.data(), val.size(), owner);
+      if (r != IoResult::kOk) return r;
+      if (comm_.rank() == rr && op != nullptr) {
+        TRT_CHECK(op->nbytes == val.size(),
+                  "bootstrap replay size mismatch for '%s': %zu != %zu",
+                  key.c_str(), op->nbytes, val.size());
+        memcpy(op->buf, val.data(), val.size());
+        CommitResult(op, val);
+        op->served = true;
+      }
+    }
+    return IoResult::kOk;
+  }
+
+  // Serve the lowest outstanding seqno from any rank that still holds its
+  // result (reference TryGetResult/TryRecoverData, allreduce_robust.cc:1103).
+  IoResult ServeSeqno(const std::vector<PeerState>& table, OpCtx* op) {
+    uint32_t s = UINT32_MAX;
+    for (const auto& p : table) {
+      uint32_t m = p.flags & kModeMask;
+      if (m == kStInLoadCheck) continue;
+      s = std::min(s, p.seqno);
+    }
+    auto it = resbuf_.find(s);
+    uint64_t vote = it != resbuf_.end() ? it->second.size() + 1 : 0;
+    int owner = -1;
+    uint64_t size = 0;
+    IoResult r = Elect(vote, &owner, &size);
+    if (r != IoResult::kOk) return r;
+    TRT_CHECK(owner >= 0,
+              "replay result for seq %u lost (too many simultaneous "
+              "failures); raise rabit_global_replica",
+              s);
+    std::string val(size, '\0');
+    if (comm_.rank() == owner) val = resbuf_[s];
+    r = comm_.Broadcast(val.data(), val.size(), owner);
+    if (r != IoResult::kOk) return r;
+    if (seqno_ == s && op != nullptr) {
+      TRT_CHECK(op->nbytes == val.size(),
+                "replay size mismatch at seq %u: %zu != %zu (nondeterministic "
+                "op sequence?)",
+                s, op->nbytes, val.size());
+      memcpy(op->buf, val.data(), val.size());
+      CommitResult(op, val);
+      op->served = true;
+    }
+    return IoResult::kOk;
+  }
+
+  // --- live execution -----------------------------------------------------
+
+  // Run the collective on a scratch copy so a half-finished attempt never
+  // corrupts the retry input (the reference runs ops in resbuf temp space
+  // for the same reason, allreduce_robust.cc:276-288).
+  void RunLive(OpCtx* op, const std::function<IoResult(char*)>& body) {
+    std::string scratch;
+    while (true) {
+      scratch.assign(op->buf, op->nbytes);
+      if (body(scratch.data()) == IoResult::kOk) break;
+      CheckAndRecover();
+      if (RecoverExec(op, 0)) return;  // a peer finished it; result adopted
+    }
+    memcpy(op->buf, scratch.data(), op->nbytes);
+    CommitResult(op, scratch);
+  }
+
+  // Record a completed op: replay log with rotating-replica retention (each
+  // seqno is retained by ~num_global_replica ranks; reference drop rule,
+  // allreduce_robust.cc:269-273) and the bootstrap cache for
+  // pre-LoadCheckPoint ops.
+  void CommitResult(OpCtx* op, const std::string& result) {
+    resbuf_[seqno_] = result;
+    for (auto rit = resbuf_.begin(); rit != resbuf_.end();) {
+      if (rit->first != seqno_ &&
+          rit->first % static_cast<uint32_t>(result_round_) !=
+              static_cast<uint32_t>(comm_.rank() % result_round_)) {
+        rit = resbuf_.erase(rit);
+      } else {
+        ++rit;
+      }
+    }
+    if (!loaded_ && boot_cache_on_ && !op->key.empty()) {
+      boot_cache_[BootKey(op->key)] = result;
+    }
+    ++seqno_;
+  }
+
+  // Caller-site keys repeat when a pre-load op sits in a loop; suffix with
+  // the pre-load op ordinal (== seqno_, which only resets at LoadCheckPoint,
+  // after which no more entries are made) so entries stay unique across
+  // replays (the reference keys add shape info only, rabit.h:29-37).
+  std::string BootKey(const std::string& key) const {
+    return key + "#" + std::to_string(seqno_);
+  }
+
+  // --- checkpoint ---------------------------------------------------------
+
+  void CheckPointImpl(const char* gdata, size_t glen, const char* ldata,
+                      size_t llen, bool lazy) {
+    double t0 = NowSec();
+    if (!comm_.distributed()) {
+      StoreGlobal(gdata, glen, lazy);
+      if (ldata != nullptr) local_ckpt_.assign(ldata, ldata + llen);
+      ++version_;
+      return;
+    }
+    if (num_local_replica_ < 0) {
+      // First checkpoint fixes the local-model policy (reference
+      // LocalModelCheck, allreduce_robust.cc:455-471).
+      num_local_replica_ = ldata != nullptr ? local_replica_cfg_ : 0;
+    } else {
+      TRT_CHECK((ldata != nullptr) == (num_local_replica_ > 0),
+                "checkpoint local-model usage must be consistent across "
+                "iterations");
+    }
+    skip_replicate_ = false;
+    while (true) {
+      RecoverExec(nullptr, kStInCheckPoint);
+      TestHookAfterBarrier();
+      if (num_local_replica_ == 0 || skip_replicate_) break;
+      if (ReplicateLocal(ldata, llen) == IoResult::kOk) break;
+      CheckAndRecover();
+    }
+    // Commit: everything between the barriers is local, so every rank that
+    // reaches a consensus round afterwards is observably pre- or
+    // post-commit, never in between.
+    StoreGlobal(gdata, glen, lazy);
+    if (num_local_replica_ > 0) {
+      local_ckpt_.assign(ldata, ldata + llen);
+      local_replicas_ = std::move(staged_replicas_);
+      staged_replicas_.clear();
+    }
+    ++version_;
+    seqno_ = 0;
+    resbuf_.clear();
+    RecoverExec(nullptr, kStInCheckAck);
+    if (debug_) {
+      fprintf(stderr, "[%d] checkpoint to version %d took %f s\n",
+              comm_.rank(), version_, NowSec() - t0);
+    }
+  }
+
+  // Fault-injection seam: the mock engine kills here to exercise the
+  // post-barrier / pre-commit window (see MockEngine, seqno spec -3).
+  virtual void TestHookAfterBarrier() {}
+
+  void StoreGlobal(const char* gdata, size_t glen, bool lazy) {
+    if (lazy) {
+      // Defer the copy until a failure actually needs the blob (reference
+      // LazyCheckPoint/global_lazycheck, rabit.h:311-332): caller keeps the
+      // buffer alive and unchanged until the next checkpoint.
+      lazy_ptr_ = gdata;
+      lazy_len_ = glen;
+      has_lazy_ = true;
+      global_ckpt_.clear();
+    } else {
+      global_ckpt_.assign(gdata, gdata + glen);
+      has_lazy_ = false;
+    }
+  }
+
+  void MaterializeGlobal() {
+    if (has_lazy_) {
+      global_ckpt_.assign(lazy_ptr_, lazy_ptr_ + lazy_len_);
+      has_lazy_ = false;
+    }
+  }
+
+  // Chain my new local blob around the ring so my num_local_replica ring
+  // successors hold a copy; symmetric, so I stage my predecessors' blobs
+  // (reference TryCheckinLocalState/RingPassing, allreduce_robust.cc:1475).
+  // Staged, not committed: a loader served mid-checkpoint must see the
+  // previous version's replicas (the reference double-buffers local_chkpt[2]
+  // for the same reason).
+  IoResult ReplicateLocal(const char* ldata, size_t llen) {
+    const int n = comm_.world();
+    staged_replicas_.clear();
+    std::string prev(ldata, ldata + llen);
+    for (int k = 1; k <= num_local_replica_ && k < n; ++k) {
+      uint64_t out_size = prev.size(), in_size = 0;
+      IoResult r = comm_.RingExchange(&out_size, sizeof(out_size), &in_size,
+                                      sizeof(in_size));
+      if (r != IoResult::kOk) return r;
+      std::string in(in_size, '\0');
+      r = comm_.RingExchange(prev.data(), prev.size(), in.data(), in.size());
+      if (r != IoResult::kOk) return r;
+      staged_replicas_[(comm_.rank() - k + n) % n] = in;
+      prev = std::move(in);
+    }
+    return IoResult::kOk;
+  }
+
+  Config cfg_;
+  Comm comm_;
+  Watchdog watchdog_;
+
+  int version_ = 0;
+  uint32_t seqno_ = 0;
+  bool loaded_ = false;
+
+  std::string global_ckpt_;
+  const char* lazy_ptr_ = nullptr;
+  size_t lazy_len_ = 0;
+  bool has_lazy_ = false;
+
+  std::string local_ckpt_;                      // my own local model blob
+  std::map<int, std::string> local_replicas_;   // rank -> blob I replicate
+  std::map<int, std::string> staged_replicas_;  // mid-checkpoint staging
+  int num_local_replica_ = -1;                  // fixed at first checkpoint
+  int local_replica_cfg_ = 2;
+
+  std::map<uint32_t, std::string> resbuf_;  // seqno -> result (this version)
+  int num_global_replica_ = 5;
+  int result_round_ = 1;
+
+  bool boot_cache_on_ = false;
+  std::map<std::string, std::string> boot_cache_;
+  bool skip_replicate_ = false;
+
+  bool debug_ = false;
+  double timeout_sec_ = 0;
+};
+
+// Deterministic fault injection on top of the robust engine (reference:
+// src/allreduce_mock.h).  `mock=rank,version,seqno,trial` entries — multiple
+// separated by ';' in one value, since the config layer is a map — kill the
+// process (throw) right before the matching operation on the matching life
+// (trial = DMLC_NUM_ATTEMPT, incremented by the launcher on each restart).
+class MockEngine : public RobustEngine {
+ public:
+  void Init(const Config& cfg) override {
+    RobustEngine::Init(cfg);
+    ntrial_ = static_cast<int>(cfg.GetInt("rabit_num_trial", 0));
+    force_local_ = cfg.GetBool("force_local", false);
+    report_stats_ = cfg.GetBool("report_stats", false);
+    std::string spec = cfg.Get("mock", "");
+    size_t pos = 0;
+    while (pos < spec.size()) {
+      size_t end = spec.find(';', pos);
+      if (end == std::string::npos) end = spec.size();
+      std::string entry = spec.substr(pos, end - pos);
+      int r, v, s, t;
+      if (sscanf(entry.c_str(), "%d,%d,%d,%d", &r, &v, &s, &t) == 4) {
+        kills_.insert({r, v, s, t});
+      } else if (!entry.empty()) {
+        throw Error(Format("bad mock entry '%s'", entry.c_str()));
+      }
+      pos = end + 1;
+    }
+  }
+
+  void Allreduce(void* buf, size_t elem_size, size_t count, ReduceFn fn,
+                 void* fn_ctx, PrepareFn prepare_fn, void* prepare_arg,
+                 const char* cache_key) override {
+    Verify("AllReduce");
+    double t0 = NowSec();
+    RobustEngine::Allreduce(buf, elem_size, count, fn, fn_ctx, prepare_fn,
+                            prepare_arg, cache_key);
+    tsum_allreduce_ += NowSec() - t0;
+  }
+
+  void Broadcast(void* buf, size_t size, int root, const char* cache_key) override {
+    Verify("Broadcast");
+    RobustEngine::Broadcast(buf, size, root, cache_key);
+  }
+
+  void Allgather(void* buf, size_t total, size_t beg, size_t end,
+                 const char* cache_key) override {
+    Verify("Allgather");
+    double t0 = NowSec();
+    RobustEngine::Allgather(buf, total, beg, end, cache_key);
+    tsum_allgather_ += NowSec() - t0;
+  }
+
+  int LoadCheckPoint(std::string* g, std::string* l) override {
+    VerifyAt(kSeqLoadCheckPoint, "LoadCheckPoint");
+    return RobustEngine::LoadCheckPoint(g, l);
+  }
+
+  void CheckPoint(const char* gdata, size_t glen, const char* ldata,
+                  size_t llen) override {
+    VerifyAt(kSeqCheckPoint, "CheckPoint");
+    if (report_stats_) {
+      TrackerPrint(Format(
+          "[%d] version %d: allreduce %.6fs, allgather %.6fs, ckpt %zu B",
+          rank(), VersionNumber(), tsum_allreduce_, tsum_allgather_, glen));
+      tsum_allreduce_ = tsum_allgather_ = 0;
+    }
+    if (force_local_ && ldata == nullptr) {
+      // Reroute the global model through the local ring-replication path
+      // (reference force_local + DummySerializer/ComboSerializer,
+      // allreduce_mock.h:143-168).
+      RobustEngine::CheckPoint(gdata, glen, gdata, glen);
+    } else {
+      RobustEngine::CheckPoint(gdata, glen, ldata, llen);
+    }
+  }
+
+ protected:
+  void TestHookAfterBarrier() override {
+    VerifyAt(kSeqAfterBarrier, "checkpoint-commit window");
+  }
+
+ private:
+  // Negative seqno specs address points the reference mock cannot reach:
+  // -1 = CheckPoint entry, -2 = LoadCheckPoint entry, -3 = after the
+  // checkpoint phase-1 barrier (pre-replication/commit).
+  static constexpr int kSeqCheckPoint = -1;
+  static constexpr int kSeqLoadCheckPoint = -2;
+  static constexpr int kSeqAfterBarrier = -3;
+
+  void Verify(const char* op) { VerifyAt(static_cast<int>(seqno_), op); }
+
+  void VerifyAt(int seq, const char* op) {
+    MockKey k{rank(), version_, seq, ntrial_};
+    if (kills_.count(k) != 0) {
+      TrackerPrint(Format("[%d] mock kill before %s version=%d seq=%d trial=%d",
+                          rank(), op, version_, seq, ntrial_));
+      throw Error(Format("[%d] mock kill @version=%d seq=%d trial=%d", rank(),
+                         version_, seq, ntrial_));
+    }
+  }
+
+  struct MockKey {
+    int rank, version, seqno, trial;
+    bool operator<(const MockKey& o) const {
+      return std::tie(rank, version, seqno, trial) <
+             std::tie(o.rank, o.version, o.seqno, o.trial);
+    }
+  };
+
+  std::set<MockKey> kills_;
+  int ntrial_ = 0;
+  bool force_local_ = false;
+  bool report_stats_ = false;
+  double tsum_allreduce_ = 0, tsum_allgather_ = 0;
+};
+
 std::unique_ptr<Engine> CreateRobustEngine() {
-  throw Error("robust engine not built yet; use rabit_engine=base");
+  return std::make_unique<RobustEngine>();
 }
 
 std::unique_ptr<Engine> CreateMockEngine() {
-  throw Error("mock engine not built yet; use rabit_engine=base");
+  return std::make_unique<MockEngine>();
 }
 
 }  // namespace tpurabit

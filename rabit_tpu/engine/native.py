@@ -61,7 +61,13 @@ def load_lib() -> ctypes.CDLL:
             ctypes.c_char_p
         ]
         lib.RabitBroadcast.argtypes = [ctypes.c_void_p, ctypes.c_uint64, ctypes.c_int]
+        lib.RabitBroadcastKeyed.argtypes = lib.RabitBroadcast.argtypes + [
+            ctypes.c_char_p
+        ]
         lib.RabitAllgather.argtypes = [ctypes.c_void_p] + [ctypes.c_uint64] * 4
+        lib.RabitAllgatherKeyed.argtypes = [ctypes.c_void_p] + [
+            ctypes.c_uint64
+        ] * 3 + [ctypes.c_char_p]
         lib.RabitCheckPoint.argtypes = [
             ctypes.c_char_p, ctypes.c_uint64, ctypes.c_char_p, ctypes.c_uint64
         ]
@@ -188,12 +194,13 @@ class NativeEngine(Engine):
 
     def broadcast(self, data, root, cache_key=None):
         rank = self.get_rank()
+        key = (cache_key or "").encode()
         # two-phase: length then payload (reference python/rabit.py:171-206)
         length = np.array([len(data) if rank == root and data is not None else 0],
                           np.uint64)
         self._check(
-            self._lib.RabitBroadcast(
-                length.ctypes.data_as(ctypes.c_void_p), 8, root
+            self._lib.RabitBroadcastKeyed(
+                length.ctypes.data_as(ctypes.c_void_p), 8, root, key
             ),
             "broadcast",
         )
@@ -203,8 +210,8 @@ class NativeEngine(Engine):
             buf[:] = np.frombuffer(data, np.uint8)
         if n > 0:
             self._check(
-                self._lib.RabitBroadcast(
-                    buf.ctypes.data_as(ctypes.c_void_p), n, root
+                self._lib.RabitBroadcastKeyed(
+                    buf.ctypes.data_as(ctypes.c_void_p), n, root, key
                 ),
                 "broadcast",
             )
@@ -218,9 +225,10 @@ class NativeEngine(Engine):
         out = np.zeros(world * flat.size, flat.dtype)
         out[rank * flat.size:(rank + 1) * flat.size] = flat
         self._check(
-            self._lib.RabitAllgather(
+            self._lib.RabitAllgatherKeyed(
                 out.ctypes.data_as(ctypes.c_void_p), out.nbytes,
-                rank * nbytes, (rank + 1) * nbytes, nbytes,
+                rank * nbytes, (rank + 1) * nbytes,
+                (cache_key or "").encode(),
             ),
             "allgather",
         )
@@ -258,12 +266,16 @@ class NativeEngine(Engine):
 
     def lazy_checkpoint(self, get_global_blob: Callable[[], bytes]) -> None:
         # The ABI lazy path stores a pointer without copying; from Python we
-        # must keep the serialized bytes alive ourselves.
-        self._lazy_blob = get_global_blob()
+        # must keep the serialized bytes alive ourselves.  The PREVIOUS blob
+        # must stay alive through this call too: the engine may still serve
+        # it to a recovering peer during the new checkpoint's pre-commit
+        # consensus, so only drop it after the engine has switched over.
+        new_blob = get_global_blob()
         self._check(
-            self._lib.RabitLazyCheckPoint(self._lazy_blob, len(self._lazy_blob)),
+            self._lib.RabitLazyCheckPoint(new_blob, len(new_blob)),
             "lazy_checkpoint",
         )
+        self._lazy_blob = new_blob
 
     def version_number(self):
         return self._lib.RabitVersionNumber()

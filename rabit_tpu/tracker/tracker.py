@@ -131,9 +131,21 @@ class Tracker:
 
     def _assign_and_send(self, wave: list[_Pending], epoch: int) -> None:
         # Stable ranks: task ids seen before keep their rank (re-admission of
-        # a restarted worker, reference ReConnectLinks "recover"); new ids
-        # fill the free slots in check-in order.
+        # a restarted worker, reference ReConnectLinks "recover").  New ids
+        # get rank == int(task_id) when the launcher numbered them (so
+        # mock-kill specs and launcher restart counters line up), otherwise
+        # fill free slots in check-in order.
         taken = {self._ranks[p.task_id] for p in wave if p.task_id in self._ranks}
+        for p in wave:
+            if p.task_id in self._ranks:
+                continue
+            try:
+                cand = int(p.task_id)
+            except ValueError:
+                continue
+            if 0 <= cand < self.world_size and cand not in taken:
+                self._ranks[p.task_id] = cand
+                taken.add(cand)
         free = iter(r for r in range(self.world_size) if r not in taken)
         for p in wave:
             if p.task_id not in self._ranks:

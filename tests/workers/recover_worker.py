@@ -1,0 +1,117 @@
+"""Self-verifying fault-tolerance workload.
+
+Mirrors the reference's integration test programs
+(``/root/reference/test/model_recover.cc``, ``local_recover.cc``,
+``lazy_recover.cc``): each iteration computes MAX/SUM allreduces, a
+broadcast, and an allgather whose expected values are known in closed form
+and checks every element, then checkpoints.  Run under the local cluster
+launcher with ``mock=rank,version,seqno,trial`` args, the process is killed
+at exactly those points, restarted by the launcher, and must recover its
+model from peers and still produce correct results.
+
+Worker args (k=v on the command line, all also forwarded to the engine):
+    ndata=N        elements per collective (default 100)
+    niter=N        iterations == checkpoints (default 3)
+    local=1        also checkpoint a per-rank local model
+    lazy=1         use lazy_checkpoint
+    preload_op=1   run a keyed broadcast before load_checkpoint
+                   (exercises the bootstrap cache)
+"""
+
+import sys
+
+import numpy as np
+
+sys.path.insert(0, __file__.rsplit("/tests/", 1)[0])
+
+import rabit_tpu as rt
+
+
+def getarg(name: str, default: str) -> str:
+    for a in sys.argv[1:]:
+        if a.startswith(name + "="):
+            return a.split("=", 1)[1]
+    return default
+
+
+def check(cond: bool, what: str) -> None:
+    if not cond:
+        raise AssertionError(
+            f"[{rt.get_rank()}] self-check failed: {what}"
+        )
+
+
+def main() -> int:
+    ndata = int(getarg("ndata", "100"))
+    niter = int(getarg("niter", "3"))
+    use_local = getarg("local", "0") == "1"
+    use_lazy = getarg("lazy", "0") == "1"
+    preload_op = getarg("preload_op", "0") == "1"
+
+    rt.init()
+    rank = rt.get_rank()
+    world = rt.get_world_size()
+
+    if preload_op:
+        # A collective issued before load_checkpoint: replayed from the
+        # bootstrap cache when this process is a restart (reference
+        # README.md:25-28).
+        cfg = rt.broadcast({"seed": 42, "ndata": ndata} if rank == 0 else None, 0)
+        check(cfg == {"seed": 42, "ndata": ndata}, f"preload broadcast {cfg}")
+
+    if use_local:
+        version, model, lmodel = rt.load_checkpoint(with_local=True)
+    else:
+        version, model = rt.load_checkpoint()
+        lmodel = None
+    if version == 0:
+        model = {"iter": 0, "history": []}
+        lmodel = {"rank": rank, "iter": 0}
+    check(model["iter"] == version, f"model {model} vs version {version}")
+    if use_local:
+        check(lmodel["rank"] == rank, f"local model {lmodel} not mine")
+
+    for it in range(version, niter):
+        # MAX: data[i] = rank + i + it  ->  world-1 + i + it
+        a = (np.arange(ndata) + rank + it).astype(np.float32)
+        out = rt.allreduce(a, rt.MAX)
+        expect = (np.arange(ndata) + world - 1 + it).astype(np.float32)
+        check(np.array_equal(out, expect), f"iter {it} max {out[:4]}")
+
+        # broadcast an object from a rotating root
+        root = it % world
+        msg = {"iter": it, "root": root}
+        got = rt.broadcast(msg if rank == root else None, root)
+        check(got == msg, f"iter {it} bcast {got}")
+
+        # SUM: data[i] = i + rank + it -> world*(i+it) + world*(world-1)/2
+        a = (np.arange(ndata) + rank + it).astype(np.float64)
+        out = rt.allreduce(a, rt.SUM)
+        expect = (world * (np.arange(ndata) + it) + world * (world - 1) / 2
+                  ).astype(np.float64)
+        check(np.array_equal(out, expect), f"iter {it} sum {out[:4]}")
+
+        # allgather of a per-rank vector
+        g = rt.allgather(np.array([rank, it, rank * it], np.int64))
+        expect = np.array([[r, it, r * it] for r in range(world)], np.int64)
+        check(np.array_equal(g, expect), f"iter {it} allgather {g}")
+
+        model["iter"] = it + 1
+        model["history"].append(it)
+        if use_local:
+            lmodel["iter"] = it + 1
+            rt.checkpoint(model, lmodel)
+        elif use_lazy:
+            rt.lazy_checkpoint(model)
+        else:
+            rt.checkpoint(model)
+        check(rt.version_number() == it + 1, "version after checkpoint")
+
+    check(model["history"] == list(range(niter)), f"history {model['history']}")
+    rt.tracker_print(f"[{rank}] all {niter} iterations verified")
+    rt.finalize()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
