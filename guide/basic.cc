@@ -1,0 +1,27 @@
+// Basic allreduce demo on the typed C++ API (parity with
+// /root/reference/guide/basic.cc): every rank fills a vector with rank+i,
+// then MAX- and SUM-allreduces it.
+//
+// Build: make -C guide    Run: see guide/README.md
+#include <tpurabit/tpurabit.h>
+
+#include <cstdio>
+#include <cstdlib>
+#include <vector>
+
+int main(int argc, char* argv[]) {
+  int n = 3;
+  if (argc > 1 && atoi(argv[1]) > 0) n = atoi(argv[1]);
+  tpurabit::Init(argc, argv);
+  const int rank = tpurabit::GetRank();
+  std::vector<int> a(n);
+  for (int i = 0; i < n; ++i) a[i] = rank + i;
+
+  printf("@node[%d] before-allreduce: a={%d, %d, %d}\n", rank, a[0], a[1], a[2]);
+  tpurabit::Allreduce<tpurabit::op::Max>(a.data(), a.size());
+  printf("@node[%d] after-allreduce-max: a={%d, %d, %d}\n", rank, a[0], a[1], a[2]);
+  tpurabit::Allreduce<tpurabit::op::Sum>(a.data(), a.size());
+  printf("@node[%d] after-allreduce-sum: a={%d, %d, %d}\n", rank, a[0], a[1], a[2]);
+  tpurabit::Finalize();
+  return 0;
+}

@@ -1,0 +1,30 @@
+#!/usr/bin/env python
+"""Basic allreduce demo (parity with /root/reference/guide/basic.py):
+every rank fills a vector with rank+i, then MAX- and SUM-allreduces it.
+
+Run standalone (solo mode) or under the local tracker:
+    python -m rabit_tpu.tracker.launcher -n 4 -- python guide/basic.py rabit_engine=robust
+"""
+import numpy as np
+
+import os
+import sys
+
+# for a normal run without the tracker script, make the repo importable
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import rabit_tpu as rabit  # noqa: E402
+
+rabit.init()
+n = 3
+rank = rabit.get_rank()
+a = np.zeros(n)
+for i in range(n):
+    a[i] = rank + i
+
+print(f"@node[{rank}] before-allreduce: a={a}")
+a = rabit.allreduce(a, rabit.MAX)
+print(f"@node[{rank}] after-allreduce-max: a={a}")
+a = rabit.allreduce(a, rabit.SUM)
+print(f"@node[{rank}] after-allreduce-sum: a={a}")
+rabit.finalize()

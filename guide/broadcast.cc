@@ -1,0 +1,18 @@
+// Broadcast demo on the typed C++ API (parity with
+// /root/reference/guide/broadcast.cc): rank 0 broadcasts a string.
+#include <tpurabit/tpurabit.h>
+
+#include <cstdio>
+#include <string>
+
+int main(int argc, char* argv[]) {
+  tpurabit::Init(argc, argv);
+  const int rank = tpurabit::GetRank();
+  std::string s;
+  if (rank == 0) s = "hello world";
+  printf("@node[%d] before-broadcast: s=\"%s\"\n", rank, s.c_str());
+  tpurabit::Broadcast(&s, 0);
+  printf("@node[%d] after-broadcast: s=\"%s\"\n", rank, s.c_str());
+  tpurabit::Finalize();
+  return 0;
+}

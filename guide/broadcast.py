@@ -1,0 +1,24 @@
+#!/usr/bin/env python
+"""Broadcast demo (parity with /root/reference/guide/broadcast.py):
+rank 0 broadcasts an arbitrary picklable object to everyone.
+
+Run under the local tracker:
+    python -m rabit_tpu.tracker.launcher -n 4 -- python guide/broadcast.py rabit_engine=robust
+"""
+import os
+import sys
+
+# for a normal run without the tracker script, make the repo importable
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import rabit_tpu as rabit  # noqa: E402
+
+rabit.init()
+rank = rabit.get_rank()
+s = None
+if rank == 0:
+    s = {"hello world": 100, 2: 3}
+print(f'@node[{rank}] before-broadcast: s="{s}"')
+s = rabit.broadcast(s, 0)
+print(f'@node[{rank}] after-broadcast: s="{s}"')
+rabit.finalize()

@@ -1,0 +1,534 @@
+// tpurabit.h — the public typed C++ API of the tpurabit native engine.
+//
+// Capability parity with the reference's user-facing C++ header
+// (/root/reference/include/rabit/rabit.h:94-456 + internal/rabit-inl.h):
+// Init/Finalize, typed Allreduce<OP,DType>, vector/string Broadcast,
+// slice-addressed Allgather, CheckPoint/LoadCheckPoint/LazyCheckPoint,
+// custom Reducer<DType,freduce> and SerializeReducer<DType>, and the
+// op::{Max,Min,Sum,BitOR} functors.  Unlike the reference, which binds the
+// backend at link time, this header is a header-only layer over the stable
+// C ABI (tpurabit/c_api.h) — the backend (solo / base / robust / mock) is
+// chosen at Init time by the rabit_engine=... parameter.
+//
+// Caller-site capture: every collective takes hidden _file/_line/_caller
+// defaults (reference rabit.h:29-37) that become the bootstrap-cache key,
+// so a restarted worker can replay pre-checkpoint collectives.
+//
+// Thread safety: like the reference (rabit.h:178), the API is NOT
+// thread-safe; call it from one thread.
+#ifndef TPURABIT_TPURABIT_H_
+#define TPURABIT_TPURABIT_H_
+
+#include <algorithm>
+#include <cstdarg>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <functional>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+#include "c_api.h"
+
+namespace tpurabit {
+
+#if defined(__GNUC__) || defined(__clang__)
+#define TPURABIT_FILE __builtin_FILE()
+#define TPURABIT_LINE __builtin_LINE()
+#define TPURABIT_CALLER __builtin_FUNCTION()
+#else
+#define TPURABIT_FILE "N/A"
+#define TPURABIT_LINE 0
+#define TPURABIT_CALLER "N/A"
+#endif
+
+// ---------------------------------------------------------------------------
+// Streams + Serializable (reference: serializable.h re-exporting dmlc::
+// Stream/Serializable; internal/io.h MemoryFixSizeBuffer/MemoryBufferStream).
+// ---------------------------------------------------------------------------
+
+/// Minimal binary stream contract for model serialization.
+class Stream {
+ public:
+  virtual ~Stream() = default;
+  virtual size_t Read(void* ptr, size_t size) = 0;
+  virtual void Write(const void* ptr, size_t size) = 0;
+  virtual void Seek(size_t pos) = 0;
+  virtual size_t Tell() = 0;
+};
+
+/// Fixed-capacity stream over caller-owned memory (reference:
+/// utils::MemoryFixSizeBuffer, internal/io.h:24-70).
+class MemoryFixSizeBuffer : public Stream {
+ public:
+  MemoryFixSizeBuffer(void* mem, size_t size)
+      : p_(static_cast<char*>(mem)), size_(size) {}
+  size_t Read(void* ptr, size_t size) override {
+    size_t n = std::min(size, size_ - pos_);
+    if (n != 0) std::memcpy(ptr, p_ + pos_, n);
+    pos_ += n;
+    return n;
+  }
+  void Write(const void* ptr, size_t size) override {
+    if (size == 0) return;
+    std::memcpy(p_ + pos_, ptr, size);
+    pos_ += size;
+  }
+  void Seek(size_t pos) override { pos_ = pos; }
+  size_t Tell() override { return pos_; }
+
+ private:
+  char* p_;
+  size_t pos_ = 0, size_;
+};
+
+/// Growable stream over a std::string (reference: utils::MemoryBufferStream,
+/// internal/io.h:73-111).
+class MemoryBufferStream : public Stream {
+ public:
+  explicit MemoryBufferStream(std::string* buf) : buf_(buf) {}
+  size_t Read(void* ptr, size_t size) override {
+    size_t n = std::min(size, buf_->size() - pos_);
+    if (n != 0) std::memcpy(ptr, buf_->data() + pos_, n);
+    pos_ += n;
+    return n;
+  }
+  void Write(const void* ptr, size_t size) override {
+    if (size == 0) return;
+    if (pos_ + size > buf_->size()) buf_->resize(pos_ + size);
+    std::memcpy(&(*buf_)[pos_], ptr, size);
+    pos_ += size;
+  }
+  void Seek(size_t pos) override { pos_ = pos; }
+  size_t Tell() override { return pos_; }
+
+ private:
+  std::string* buf_;
+  size_t pos_ = 0;
+};
+
+/// Checkpointable-model contract (reference: dmlc::Serializable via
+/// rabit/serializable.h).
+class Serializable {
+ public:
+  virtual ~Serializable() = default;
+  virtual void Load(Stream* fi) = 0;
+  virtual void Save(Stream* fo) const = 0;
+};
+
+// ---------------------------------------------------------------------------
+// Error handling: the C ABI reports via return code + message; the C++
+// layer re-raises (mirroring the reference, where utils::Check throws
+// dmlc::Error straight through rabit.h calls).
+// ---------------------------------------------------------------------------
+
+#ifndef TPURABIT_ERROR_DEFINED
+#define TPURABIT_ERROR_DEFINED
+class Error : public std::runtime_error {
+ public:
+  explicit Error(const std::string& msg) : std::runtime_error(msg) {}
+};
+#endif
+
+namespace detail {
+inline void Check(int rc, const char* what) {
+  if (rc != 0) {
+    throw Error(std::string(what) + ": " + TrtGetLastError());
+  }
+}
+inline std::string CacheKey(const char* file, int line, const char* caller) {
+  char buf[512];
+  std::snprintf(buf, sizeof(buf), "%s::%d::%s", file, line, caller);
+  return std::string(buf);
+}
+// dtype enum for the builtin-op fast path; -1 = not a builtin dtype.
+template <typename T>
+struct TypeEnum {
+  static const int value = -1;
+};
+template <> struct TypeEnum<int8_t>   { static const int value = 0; };
+template <> struct TypeEnum<uint8_t>  { static const int value = 1; };
+template <> struct TypeEnum<int32_t>  { static const int value = 2; };
+template <> struct TypeEnum<uint32_t> { static const int value = 3; };
+template <> struct TypeEnum<int64_t>  { static const int value = 4; };
+template <> struct TypeEnum<uint64_t> { static const int value = 5; };
+template <> struct TypeEnum<float>    { static const int value = 6; };
+template <> struct TypeEnum<double>   { static const int value = 7; };
+
+inline void InvokeLambda(void* fun) {
+  (*static_cast<std::function<void()>*>(fun))();
+}
+}  // namespace detail
+
+// ---------------------------------------------------------------------------
+// Reduction operators (reference: op::{Max,Min,Sum,BitOR},
+// rabit-inl.h:67-94).  kEnum is the ABI op id.
+// ---------------------------------------------------------------------------
+
+namespace op {
+struct Max {
+  static const int kEnum = 0;
+  template <typename T>
+  static void Reduce(T& dst, const T& src) {  // NOLINT(runtime/references)
+    if (dst < src) dst = src;
+  }
+};
+struct Min {
+  static const int kEnum = 1;
+  template <typename T>
+  static void Reduce(T& dst, const T& src) {  // NOLINT(runtime/references)
+    if (src < dst) dst = src;
+  }
+};
+struct Sum {
+  static const int kEnum = 2;
+  template <typename T>
+  static void Reduce(T& dst, const T& src) {  // NOLINT(runtime/references)
+    dst += src;
+  }
+};
+struct BitOR {
+  static const int kEnum = 3;
+  template <typename T>
+  static void Reduce(T& dst, const T& src) {  // NOLINT(runtime/references)
+    dst |= src;
+  }
+};
+}  // namespace op
+
+// ---------------------------------------------------------------------------
+// Lifecycle + topology
+// ---------------------------------------------------------------------------
+
+/// Initialize the engine from "key=value" argv parameters (and the
+/// DMLC_*/rabit_* environment watch list).
+inline void Init(int argc, char* argv[]) {
+  detail::Check(RabitInit(argc, argv), "Init");
+}
+
+/// Shut down; after this no API calls are valid.
+inline void Finalize() { detail::Check(RabitFinalize(), "Finalize"); }
+
+inline int GetRank() { return RabitGetRank(); }
+inline int GetWorldSize() { return RabitGetWorldSize(); }
+inline bool IsDistributed() { return RabitIsDistributed() != 0; }
+inline int GetRingPrevRank() { return RabitGetRingPrevRank(); }
+
+inline std::string GetProcessorName() {
+  char buf[256];
+  trt_ulong len = 0;
+  detail::Check(RabitGetProcessorName(buf, &len, sizeof(buf)),
+                "GetProcessorName");
+  return std::string(buf, len);
+}
+
+/// Print a message to the tracker console (reference: TrackerPrint).
+inline void TrackerPrint(const std::string& msg) {
+  detail::Check(RabitTrackerPrint(msg.c_str()), "TrackerPrint");
+}
+
+inline void TrackerPrintf(const char* fmt, ...)
+#if defined(__GNUC__) || defined(__clang__)
+    __attribute__((format(printf, 1, 2)))
+#endif
+    ;
+inline void TrackerPrintf(const char* fmt, ...) {
+  char buf[1024];
+  va_list ap;
+  va_start(ap, fmt);
+  std::vsnprintf(buf, sizeof(buf), fmt, ap);
+  va_end(ap);
+  TrackerPrint(buf);
+}
+
+// ---------------------------------------------------------------------------
+// Collectives
+// ---------------------------------------------------------------------------
+
+/// Broadcast raw bytes from `root` to every rank.
+inline void Broadcast(void* sendrecv_data, size_t size, int root,
+                      const char* _file = TPURABIT_FILE,
+                      const int _line = TPURABIT_LINE,
+                      const char* _caller = TPURABIT_CALLER) {
+  detail::Check(
+      RabitBroadcastKeyed(sendrecv_data, size, root,
+                          detail::CacheKey(_file, _line, _caller).c_str()),
+      "Broadcast");
+}
+
+/// Broadcast a vector; non-root vectors are resized to match (two-phase
+/// size-then-payload, reference rabit-inl.h:141-155).
+template <typename DType>
+inline void Broadcast(std::vector<DType>* sendrecv_data, int root,
+                      const char* _file = TPURABIT_FILE,
+                      const int _line = TPURABIT_LINE,
+                      const char* _caller = TPURABIT_CALLER) {
+  uint64_t size = sendrecv_data->size();
+  Broadcast(&size, sizeof(size), root, _file, _line, _caller);
+  sendrecv_data->resize(size);
+  if (size != 0) {
+    Broadcast(sendrecv_data->data(), size * sizeof(DType), root, _file, _line,
+              _caller);
+  }
+}
+
+/// Broadcast a string (reference rabit-inl.h:156-169).
+inline void Broadcast(std::string* sendrecv_data, int root,
+                      const char* _file = TPURABIT_FILE,
+                      const int _line = TPURABIT_LINE,
+                      const char* _caller = TPURABIT_CALLER) {
+  uint64_t size = sendrecv_data->size();
+  Broadcast(&size, sizeof(size), root, _file, _line, _caller);
+  sendrecv_data->resize(size);
+  if (size != 0) {
+    Broadcast(&(*sendrecv_data)[0], size, root, _file, _line, _caller);
+  }
+}
+
+/// In-place typed allreduce: combine `sendrecvbuf[0..count)` across ranks
+/// with OP.  `prepare_fun(prepare_arg)` runs right before the reduction
+/// and is skipped when the result is recovered from a peer's replay
+/// buffer (lazy-prepare contract, reference rabit.h:182-206).
+template <typename OP, typename DType>
+inline void Allreduce(DType* sendrecvbuf, size_t count,
+                      void (*prepare_fun)(void*) = nullptr,
+                      void* prepare_arg = nullptr,
+                      const char* _file = TPURABIT_FILE,
+                      const int _line = TPURABIT_LINE,
+                      const char* _caller = TPURABIT_CALLER) {
+  static_assert(detail::TypeEnum<DType>::value >= 0,
+                "Allreduce<OP, DType>: DType must be one of the 8 builtin "
+                "numeric types; use Reducer<> for custom structs");
+  detail::Check(
+      RabitAllreduceKeyed(sendrecvbuf, count, detail::TypeEnum<DType>::value,
+                          OP::kEnum, prepare_fun, prepare_arg,
+                          detail::CacheKey(_file, _line, _caller).c_str()),
+      "Allreduce");
+}
+
+/// Lambda-preprocessor overload (reference rabit-inl.h C++11 variants).
+template <typename OP, typename DType>
+inline void Allreduce(DType* sendrecvbuf, size_t count,
+                      std::function<void()> prepare_fun,
+                      const char* _file = TPURABIT_FILE,
+                      const int _line = TPURABIT_LINE,
+                      const char* _caller = TPURABIT_CALLER) {
+  Allreduce<OP>(sendrecvbuf, count, detail::InvokeLambda, &prepare_fun, _file,
+                _line, _caller);
+}
+
+/// Slice-addressed ring allgather: `sendrecvbuf` is the full result buffer
+/// (`total_size` elements); this rank contributes
+/// [slice_begin, slice_end) and receives every other rank's slice
+/// (reference: IEngine::Allgather, engine.h:56-79).
+template <typename DType>
+inline void Allgather(DType* sendrecvbuf, size_t total_size,
+                      size_t slice_begin, size_t slice_end,
+                      const char* _file = TPURABIT_FILE,
+                      const int _line = TPURABIT_LINE,
+                      const char* _caller = TPURABIT_CALLER) {
+  detail::Check(
+      RabitAllgatherKeyed(sendrecvbuf, total_size * sizeof(DType),
+                          slice_begin * sizeof(DType),
+                          slice_end * sizeof(DType),
+                          detail::CacheKey(_file, _line, _caller).c_str()),
+      "Allgather");
+}
+
+// ---------------------------------------------------------------------------
+// Checkpointing (reference rabit.h:240-338)
+// ---------------------------------------------------------------------------
+
+/// Load the latest checkpoint into `global_model` (and `local_model` if
+/// given).  Returns the version number; 0 means no checkpoint exists and
+/// the caller must initialize the model itself.
+inline int LoadCheckPoint(Serializable* global_model,
+                          Serializable* local_model = nullptr) {
+  char *gp = nullptr, *lp = nullptr;
+  trt_ulong gn = 0, ln = 0;
+  int version = RabitLoadCheckPoint(&gp, &gn, &lp, &ln);
+  if (version < 0) {
+    throw Error(std::string("LoadCheckPoint: ") + TrtGetLastError());
+  }
+  if (version == 0) return 0;
+  if (global_model != nullptr && gn != 0) {
+    MemoryFixSizeBuffer fs(gp, gn);
+    global_model->Load(&fs);
+  }
+  if (local_model != nullptr && ln != 0) {
+    MemoryFixSizeBuffer fs(lp, ln);
+    local_model->Load(&fs);
+  }
+  return version;
+}
+
+/// Commit an iteration: serialize and store the model(s), bump the
+/// version.  A non-null `local_model` costs ring replication to
+/// num_local_replica successors — prefer global-only (reference
+/// rabit.h:270-292).
+inline void CheckPoint(const Serializable* global_model,
+                       const Serializable* local_model = nullptr) {
+  std::string gblob, lblob;
+  MemoryBufferStream gs(&gblob);
+  global_model->Save(&gs);
+  if (local_model != nullptr) {
+    MemoryBufferStream ls(&lblob);
+    local_model->Save(&ls);
+  }
+  detail::Check(RabitCheckPoint(gblob.data(), gblob.size(),
+                                local_model != nullptr ? lblob.data() : nullptr,
+                                local_model != nullptr ? lblob.size() : 0),
+                "CheckPoint");
+}
+
+/// Checkpoint whose blob is only *copied* lazily: the engine keeps a
+/// pointer and serves the bytes to a recovering peer on demand.  The
+/// caller must keep `global_model` unchanged until the next checkpoint
+/// (reference LazyCheckPoint contract, rabit.h:311-332).  Note: crossing
+/// the C ABI, serialization itself is eager; what stays lazy is the
+/// engine-side copy.
+inline void LazyCheckPoint(const Serializable* global_model) {
+  thread_local std::string blob;
+  std::string next;
+  MemoryBufferStream fs(&next);
+  global_model->Save(&fs);
+  // Swap only after the engine releases the previous pointer.
+  detail::Check(RabitLazyCheckPoint(next.data(), next.size()),
+                "LazyCheckPoint");
+  blob.swap(next);
+}
+
+/// Checkpoint version = number of CheckPoint calls so far.
+inline int VersionNumber() { return RabitVersionNumber(); }
+
+// ---------------------------------------------------------------------------
+// Custom reducers (reference rabit.h:352-456)
+// ---------------------------------------------------------------------------
+
+/// Typed allreduce with a user reduction function over plain structs
+/// (no pointers).  Example:
+///   struct Acc { double sum; int n; };
+///   void Merge(Acc& d, const Acc& s) { d.sum += s.sum; d.n += s.n; }
+///   Reducer<Acc, Merge> red;  red.Allreduce(&acc, 1);
+template <typename DType, void (*freduce)(DType& dst, const DType& src)>
+class Reducer {
+ public:
+  void Allreduce(DType* sendrecvbuf, size_t count,
+                 void (*prepare_fun)(void*) = nullptr,
+                 void* prepare_arg = nullptr,
+                 const char* _file = TPURABIT_FILE,
+                 const int _line = TPURABIT_LINE,
+                 const char* _caller = TPURABIT_CALLER) {
+    detail::Check(
+        TrtAllreduceCustom(sendrecvbuf, sizeof(DType), count, ReduceBytes,
+                           nullptr, prepare_fun, prepare_arg,
+                           detail::CacheKey(_file, _line, _caller).c_str()),
+        "Reducer::Allreduce");
+  }
+  void Allreduce(DType* sendrecvbuf, size_t count,
+                 std::function<void()> prepare_fun,
+                 const char* _file = TPURABIT_FILE,
+                 const int _line = TPURABIT_LINE,
+                 const char* _caller = TPURABIT_CALLER) {
+    Allreduce(sendrecvbuf, count, detail::InvokeLambda, &prepare_fun, _file,
+              _line, _caller);
+  }
+
+ private:
+  static void ReduceBytes(void* dst, const void* src, trt_ulong count,
+                          void*) {
+    DType* d = static_cast<DType*>(dst);
+    const DType* s = static_cast<const DType*>(src);
+    for (trt_ulong i = 0; i < count; ++i) freduce(d[i], s[i]);
+  }
+};
+
+/// Allreduce over objects that serialize into a fixed-size buffer.  DType
+/// must provide Load(Stream&)/Save(Stream&) and
+/// Reduce(const DType& src, size_t max_nbyte) (reference contract,
+/// rabit.h:398-456): each object is serialized into a `max_nbyte` slot,
+/// slots are allreduced with a deserialize-reduce-reserialize reducer,
+/// and results are deserialized back in place.
+template <typename DType>
+class SerializeReducer {
+ public:
+  void Allreduce(DType* sendrecvobj, size_t max_nbyte, size_t count,
+                 void (*prepare_fun)(void*) = nullptr,
+                 void* prepare_arg = nullptr,
+                 const char* _file = TPURABIT_FILE,
+                 const int _line = TPURABIT_LINE,
+                 const char* _caller = TPURABIT_CALLER) {
+    buffer_.resize(max_nbyte * count);
+    // Serialization is deferred into the prepare callback so a recovered
+    // result skips it entirely (same closure trick as the reference,
+    // rabit-inl.h:322-340).
+    Closure c{sendrecvobj, max_nbyte, count, prepare_fun, prepare_arg,
+              &buffer_};
+    slot_size_ = max_nbyte;
+    detail::Check(
+        TrtAllreduceCustom(&buffer_[0], max_nbyte, count, ReduceSlots,
+                           &slot_size_, Closure::Invoke, &c,
+                           detail::CacheKey(_file, _line, _caller).c_str()),
+        "SerializeReducer::Allreduce");
+    for (size_t i = 0; i < count; ++i) {
+      MemoryFixSizeBuffer fs(&buffer_[i * max_nbyte], max_nbyte);
+      sendrecvobj[i].Load(&fs);
+    }
+  }
+  void Allreduce(DType* sendrecvobj, size_t max_nbyte, size_t count,
+                 std::function<void()> prepare_fun,
+                 const char* _file = TPURABIT_FILE,
+                 const int _line = TPURABIT_LINE,
+                 const char* _caller = TPURABIT_CALLER) {
+    prepare_lambda_ = std::move(prepare_fun);
+    Allreduce(sendrecvobj, max_nbyte, count, InvokeStoredLambda, this, _file,
+              _line, _caller);
+  }
+
+ private:
+  struct Closure {
+    DType* sendrecvobj;
+    size_t max_nbyte, count;
+    void (*prepare_fun)(void*);
+    void* prepare_arg;
+    std::string* buffer;
+    static void Invoke(void* arg) {
+      Closure* c = static_cast<Closure*>(arg);
+      if (c->prepare_fun != nullptr) c->prepare_fun(c->prepare_arg);
+      for (size_t i = 0; i < c->count; ++i) {
+        MemoryFixSizeBuffer fs(&(*c->buffer)[i * c->max_nbyte], c->max_nbyte);
+        c->sendrecvobj[i].Save(&fs);
+      }
+    }
+  };
+  static void ReduceSlots(void* dst, const void* src, trt_ulong count,
+                          void* ctx) {
+    // `count` slots of `slot_size_` bytes each (slot size rides in via
+    // ctx); each slot is deserialized, merged with DType::Reduce, and
+    // reserialized in place (reference SerializeReducerFunc_,
+    // rabit-inl.h:299-316).
+    size_t nbyte = *static_cast<size_t*>(ctx);
+    char* d = static_cast<char*>(dst);
+    char* s = static_cast<char*>(const_cast<void*>(src));
+    for (trt_ulong i = 0; i < count; ++i) {
+      DType tdst, tsrc;
+      MemoryFixSizeBuffer fd(d + i * nbyte, nbyte);
+      MemoryFixSizeBuffer fs(s + i * nbyte, nbyte);
+      tdst.Load(&fd);
+      tsrc.Load(&fs);
+      tdst.Reduce(static_cast<const DType&>(tsrc), nbyte);
+      fd.Seek(0);
+      tdst.Save(&fd);
+    }
+  }
+  static void InvokeStoredLambda(void* self) {
+    (static_cast<SerializeReducer*>(self))->prepare_lambda_();
+  }
+  std::string buffer_;
+  size_t slot_size_ = 0;
+  std::function<void()> prepare_lambda_;
+};
+
+}  // namespace tpurabit
+#endif  // TPURABIT_TPURABIT_H_

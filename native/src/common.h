@@ -20,10 +20,15 @@ namespace tpurabit {
 
 // All internal failures throw Error; the C ABI boundary converts to
 // error codes + message (reference throws dmlc::Error through its C API).
+// Guarded so white-box tests can include both this header and the public
+// tpurabit.h (which declares the same class for API users) in one TU.
+#ifndef TPURABIT_ERROR_DEFINED
+#define TPURABIT_ERROR_DEFINED
 class Error : public std::runtime_error {
  public:
   explicit Error(const std::string& msg) : std::runtime_error(msg) {}
 };
+#endif
 
 inline std::string Format(const char* fmt, ...) {
   char buf[512];

@@ -313,6 +313,15 @@ class RobustEngine : public Engine {
         CheckAndRecover();
         continue;
       }
+      // The local-replica policy is fixed at the first checkpoint and must
+      // be identical everywhere (reference LocalModelCheck consensus,
+      // allreduce_robust.cc:455-471); ranks that don't know yet report -1.
+      for (const auto& p : table) {
+        TRT_CHECK(p.nlocal < 0 || num_local_replica_ < 0 ||
+                      p.nlocal == num_local_replica_,
+                  "ranks disagree on num_local_replica (%d vs %d)", p.nlocal,
+                  num_local_replica_);
+      }
       Act act = Decide(table);
       IoResult r = IoResult::kOk;
       switch (act) {
@@ -486,12 +495,14 @@ class RobustEngine : public Engine {
       uint32_t version;
       uint64_t glen;
       int32_t nlocal;
-    } hdr{0, 0, -1};
+      int32_t has_local;
+    } hdr{0, 0, -1, -1};
     if (comm_.rank() == owner) {
       MaterializeGlobal();
       hdr.version = static_cast<uint32_t>(version_);
       hdr.glen = global_ckpt_.size();
       hdr.nlocal = num_local_replica_;
+      hdr.has_local = has_local_model_;
     }
     IoResult r = comm_.Broadcast(&hdr, sizeof(hdr), owner);
     if (r != IoResult::kOk) return r;
@@ -506,16 +517,22 @@ class RobustEngine : public Engine {
       global_ckpt_ = std::move(blob);
       has_lazy_ = false;
       num_local_replica_ = hdr.nlocal;
+      has_local_model_ = hdr.has_local;
     }
     if (hdr.nlocal > 0) {
       // Per-loader local blobs live on the loader's ring successors
       // (reference local_chkpt ring replication, allreduce_robust.cc:1475).
+      // Only blobs from the served version may vote: a straggler released
+      // through a split commit still holds the previous version's replica,
+      // which must never be paired with the newer global checkpoint.
+      const int served_ver = static_cast<int>(hdr.version);
       for (int lr : loaders) {
         uint64_t vote = 0;
         auto it = local_replicas_.find(lr);
-        if (it != local_replicas_.end()) {
-          vote = it->second.size() + 1;
-        } else if (lr == comm_.rank() && !local_ckpt_.empty()) {
+        if (it != local_replicas_.end() && it->second.version == served_ver) {
+          vote = it->second.blob.size() + 1;
+        } else if (lr == comm_.rank() && !local_ckpt_.empty() &&
+                   local_ckpt_version_ == served_ver) {
           vote = local_ckpt_.size() + 1;
         }
         int lowner = -1;
@@ -528,18 +545,25 @@ class RobustEngine : public Engine {
                   lr, hdr.nlocal);
         std::string lblob(lsize, '\0');
         if (comm_.rank() == lowner) {
-          lblob = (lr == comm_.rank() && local_replicas_.count(lr) == 0)
-                      ? local_ckpt_
-                      : local_replicas_[lr];
+          auto mine = local_replicas_.find(lr);
+          lblob = (mine != local_replicas_.end() &&
+                   mine->second.version == served_ver)
+                      ? mine->second.blob
+                      : local_ckpt_;
         }
         r = comm_.Broadcast(lblob.data(), lblob.size(), lowner);
         if (r != IoResult::kOk) return r;
-        if (comm_.rank() == lr) local_ckpt_ = lblob;
+        if (comm_.rank() == lr) {
+          local_ckpt_ = lblob;
+          local_ckpt_version_ = served_ver;
+        }
         // Re-seed the replica on every ring successor that should hold it —
         // restarted successors lost theirs (the reference rebuilds replicas
         // with bidirectional ring passes, TryRecoverLocalState).
         for (int k = 1; k <= hdr.nlocal; ++k) {
-          if ((lr + k) % n == comm_.rank()) local_replicas_[lr] = lblob;
+          if ((lr + k) % n == comm_.rank()) {
+            local_replicas_[lr] = {served_ver, lblob};
+          }
         }
       }
     }
@@ -606,7 +630,10 @@ class RobustEngine : public Engine {
     uint32_t s = UINT32_MAX;
     for (const auto& p : table) {
       uint32_t m = p.flags & kModeMask;
-      if (m == kStInLoadCheck) continue;
+      // Same exclusions as Decide()'s seqno spread: loaders don't constrain
+      // the others, and ack-barrier ranks carry a reset seqno — electing it
+      // here would pick a seqno no rank adopts and livelock the round.
+      if (m == kStInLoadCheck || m == kStInCheckAck) continue;
       s = std::min(s, p.seqno);
     }
     auto it = resbuf_.find(s);
@@ -688,16 +715,22 @@ class RobustEngine : public Engine {
     double t0 = NowSec();
     if (!comm_.distributed()) {
       StoreGlobal(gdata, glen, lazy);
-      if (ldata != nullptr) local_ckpt_.assign(ldata, ldata + llen);
+      if (ldata != nullptr) {
+        local_ckpt_.assign(ldata, ldata + llen);
+        local_ckpt_version_ = version_ + 1;
+      }
       ++version_;
       return;
     }
-    if (num_local_replica_ < 0) {
+    if (has_local_model_ < 0) {
       // First checkpoint fixes the local-model policy (reference
-      // LocalModelCheck, allreduce_robust.cc:455-471).
-      num_local_replica_ = ldata != nullptr ? local_replica_cfg_ : 0;
+      // LocalModelCheck, allreduce_robust.cc:455-471).  The replica count
+      // is a separate knob: rabit_local_replica=0 keeps the local model
+      // un-replicated (lost if this process dies) but still checkpointed.
+      has_local_model_ = ldata != nullptr ? 1 : 0;
+      num_local_replica_ = has_local_model_ == 1 ? local_replica_cfg_ : 0;
     } else {
-      TRT_CHECK((ldata != nullptr) == (num_local_replica_ > 0),
+      TRT_CHECK((ldata != nullptr) == (has_local_model_ == 1),
                 "checkpoint local-model usage must be consistent across "
                 "iterations");
     }
@@ -713,9 +746,23 @@ class RobustEngine : public Engine {
     // reaches a consensus round afterwards is observably pre- or
     // post-commit, never in between.
     StoreGlobal(gdata, glen, lazy);
-    if (num_local_replica_ > 0) {
+    if (has_local_model_ == 1) {
       local_ckpt_.assign(ldata, ldata + llen);
-      local_replicas_ = std::move(staged_replicas_);
+      local_ckpt_version_ = version_ + 1;
+      if (skip_replicate_) {
+        // A released straggler merges whatever staging completed before the
+        // failure (each staged entry is a complete new-version blob) and
+        // keeps its older replicas — the version tag keeps stale ones out
+        // of future elections.
+        for (auto& kv : staged_replicas_) {
+          local_replicas_[kv.first] = {version_ + 1, std::move(kv.second)};
+        }
+      } else {
+        local_replicas_.clear();
+        for (auto& kv : staged_replicas_) {
+          local_replicas_[kv.first] = {version_ + 1, std::move(kv.second)};
+        }
+      }
       staged_replicas_.clear();
     }
     ++version_;
@@ -791,9 +838,19 @@ class RobustEngine : public Engine {
   size_t lazy_len_ = 0;
   bool has_lazy_ = false;
 
+  // Replicated blobs are version-tagged: during a split checkpoint commit a
+  // straggler still holds the previous version's replica, and the loader
+  // election must never pair a version-v local blob with a version-v+1
+  // global checkpoint.
+  struct LocalReplica {
+    int version = 0;
+    std::string blob;
+  };
   std::string local_ckpt_;                      // my own local model blob
-  std::map<int, std::string> local_replicas_;   // rank -> blob I replicate
+  int local_ckpt_version_ = 0;                  // version local_ckpt_ is from
+  std::map<int, LocalReplica> local_replicas_;  // rank -> blob I replicate
   std::map<int, std::string> staged_replicas_;  // mid-checkpoint staging
+  int has_local_model_ = -1;                    // fixed at first checkpoint
   int num_local_replica_ = -1;                  // fixed at first checkpoint
   int local_replica_cfg_ = 2;
 
@@ -868,12 +925,7 @@ class MockEngine : public RobustEngine {
   void CheckPoint(const char* gdata, size_t glen, const char* ldata,
                   size_t llen) override {
     VerifyAt(kSeqCheckPoint, "CheckPoint");
-    if (report_stats_) {
-      TrackerPrint(Format(
-          "[%d] version %d: allreduce %.6fs, allgather %.6fs, ckpt %zu B",
-          rank(), VersionNumber(), tsum_allreduce_, tsum_allgather_, glen));
-      tsum_allreduce_ = tsum_allgather_ = 0;
-    }
+    ReportCheckpointStats(glen);
     if (force_local_ && ldata == nullptr) {
       // Reroute the global model through the local ring-replication path
       // (reference force_local + DummySerializer/ComboSerializer,
@@ -882,6 +934,14 @@ class MockEngine : public RobustEngine {
     } else {
       RobustEngine::CheckPoint(gdata, glen, ldata, llen);
     }
+  }
+
+  void LazyCheckPoint(const char* gdata, size_t glen) override {
+    // Same kill point and stats as the eager path — lazy workloads must be
+    // injectable at checkpoint entry too.
+    VerifyAt(kSeqCheckPoint, "LazyCheckPoint");
+    ReportCheckpointStats(glen);
+    RobustEngine::LazyCheckPoint(gdata, glen);
   }
 
  protected:
@@ -898,6 +958,14 @@ class MockEngine : public RobustEngine {
   static constexpr int kSeqAfterBarrier = -3;
 
   void Verify(const char* op) { VerifyAt(static_cast<int>(seqno_), op); }
+
+  void ReportCheckpointStats(size_t glen) {
+    if (!report_stats_) return;
+    TrackerPrint(Format(
+        "[%d] version %d: allreduce %.6fs, allgather %.6fs, ckpt %zu B",
+        rank(), VersionNumber(), tsum_allreduce_, tsum_allgather_, glen));
+    tsum_allreduce_ = tsum_allgather_ = 0;
+  }
 
   void VerifyAt(int seq, const char* op) {
     MockKey k{rank(), version_, seq, ntrial_};

@@ -1,0 +1,85 @@
+// Minimal test registry: TEST(name) { ... } with CHECK_* asserts; main()
+// runs every registered case and reports pass/fail.  (The reference uses
+// gtest fetched at build time; this image has no network, so the harness
+// is vendored in ~60 lines.)
+#pragma once
+
+#include <cstdio>
+#include <exception>
+#include <functional>
+#include <string>
+#include <vector>
+
+namespace minitest {
+
+struct Case {
+  const char* name;
+  std::function<void()> fn;
+};
+
+inline std::vector<Case>& Registry() {
+  static std::vector<Case> r;
+  return r;
+}
+
+struct Register {
+  Register(const char* name, std::function<void()> fn) {
+    Registry().push_back({name, std::move(fn)});
+  }
+};
+
+struct Failure : std::exception {
+  std::string msg;
+  explicit Failure(std::string m) : msg(std::move(m)) {}
+  const char* what() const noexcept override { return msg.c_str(); }
+};
+
+inline int RunAll() {
+  int failed = 0;
+  for (const auto& c : Registry()) {
+    try {
+      c.fn();
+      printf("[ OK ] %s\n", c.name);
+    } catch (const std::exception& e) {
+      printf("[FAIL] %s: %s\n", c.name, e.what());
+      ++failed;
+    }
+  }
+  printf("%zu tests, %d failed\n", Registry().size(), failed);
+  return failed == 0 ? 0 : 1;
+}
+
+}  // namespace minitest
+
+#define TEST(name)                                             \
+  static void minitest_##name();                               \
+  static ::minitest::Register minitest_reg_##name(#name,       \
+                                                 minitest_##name); \
+  static void minitest_##name()
+
+#define CHECK_TRUE(cond)                                                   \
+  do {                                                                     \
+    if (!(cond))                                                           \
+      throw ::minitest::Failure(std::string(#cond) + " is false at " +     \
+                                __FILE__ + ":" + std::to_string(__LINE__)); \
+  } while (0)
+
+#define CHECK_EQ(a, b)                                                     \
+  do {                                                                     \
+    if (!((a) == (b)))                                                     \
+      throw ::minitest::Failure(std::string(#a " == " #b) + " failed at " + \
+                                __FILE__ + ":" + std::to_string(__LINE__)); \
+  } while (0)
+
+#define CHECK_THROWS(expr)                                                 \
+  do {                                                                     \
+    bool minitest_threw = false;                                           \
+    try {                                                                  \
+      expr;                                                                \
+    } catch (const std::exception&) {                                      \
+      minitest_threw = true;                                               \
+    }                                                                      \
+    if (!minitest_threw)                                                   \
+      throw ::minitest::Failure(std::string(#expr) + " did not throw at " + \
+                                __FILE__ + ":" + std::to_string(__LINE__)); \
+  } while (0)

@@ -1,0 +1,77 @@
+// Collective micro-benchmark — parity with the reference's
+// test/speed_test.cc: times Allreduce(max/sum) and Broadcast per payload
+// size, then allreduces the per-rank timings themselves to report
+// world-wide mean/σ latency and MB/s.  Runs solo or under the local
+// tracker:
+//
+//   python -m rabit_tpu.tracker.launcher -n 4 -- \
+//     native/tests/speed_test.run ndata=1000000 nrep=100 rabit_engine=robust
+#include <tpurabit/tpurabit.h>
+
+#include <chrono>
+#include <cmath>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+double NowSec() {
+  using clock = std::chrono::steady_clock;
+  return std::chrono::duration<double>(clock::now().time_since_epoch())
+      .count();
+}
+
+// Allreduce the per-rank timing across the world to get mean and σ
+// (reference PrintStats, test/speed_test.cc:54-71).
+void PrintStats(const char* name, double tsum, int nrep, size_t nbytes) {
+  int world = tpurabit::GetWorldSize();
+  double t = tsum / nrep;
+  double stats[2] = {t, t * t};
+  tpurabit::Allreduce<tpurabit::op::Sum>(stats, 2);
+  double mean = stats[0] / world;
+  double var = stats[1] / world - mean * mean;
+  if (tpurabit::GetRank() == 0) {
+    double mbps = nbytes / mean / 1e6;
+    tpurabit::TrackerPrintf(
+        "%s: mean=%.6fs sigma=%.2e bytes=%zu speed=%.2f MB/s\n", name, mean,
+        std::sqrt(var > 0 ? var : 0), nbytes, mbps);
+  }
+}
+
+}  // namespace
+
+int main(int argc, char* argv[]) {
+  size_t ndata = 100000;
+  int nrep = 100;
+  for (int i = 1; i < argc; ++i) {
+    if (sscanf(argv[i], "ndata=%zu", &ndata) == 1) continue;
+    if (sscanf(argv[i], "nrep=%d", &nrep) == 1) continue;
+  }
+  tpurabit::Init(argc, argv);
+  const int rank = tpurabit::GetRank();
+  std::vector<float> buf(ndata);
+
+  double t_max = 0, t_sum = 0, t_bcast = 0;
+  for (int r = 0; r < nrep; ++r) {
+    for (size_t i = 0; i < ndata; ++i) buf[i] = static_cast<float>(rank + i);
+    double t0 = NowSec();
+    tpurabit::Allreduce<tpurabit::op::Max>(buf.data(), ndata);
+    t_max += NowSec() - t0;
+
+    for (size_t i = 0; i < ndata; ++i) buf[i] = static_cast<float>(rank + i);
+    t0 = NowSec();
+    tpurabit::Allreduce<tpurabit::op::Sum>(buf.data(), ndata);
+    t_sum += NowSec() - t0;
+
+    t0 = NowSec();
+    tpurabit::Broadcast(buf.data(), ndata * sizeof(float), 0);
+    t_bcast += NowSec() - t0;
+  }
+  PrintStats("allreduce-max", t_max, nrep, ndata * sizeof(float));
+  PrintStats("allreduce-sum", t_sum, nrep, ndata * sizeof(float));
+  PrintStats("broadcast    ", t_bcast, nrep, ndata * sizeof(float));
+  tpurabit::Finalize();
+  return 0;
+}

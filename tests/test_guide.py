@@ -1,0 +1,109 @@
+"""Guide-example smoke tests — the reference's tier 3 (SURVEY.md §4:
+``guide/`` programs run under the demo tracker, correctness by inspection;
+here we assert on the printed reductions)."""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from rabit_tpu.tracker.launcher import LocalCluster
+
+REPO = Path(__file__).resolve().parents[1]
+GUIDE = REPO / "guide"
+
+
+def run_solo(cmd: list[str]) -> str:
+    proc = subprocess.run(
+        cmd, capture_output=True, text=True, timeout=60, cwd=REPO
+    )
+    assert proc.returncode == 0, proc.stderr
+    return proc.stdout
+
+
+def test_basic_py_solo():
+    out = run_solo([sys.executable, str(GUIDE / "basic.py")])
+    # solo mode: allreduce is identity
+    assert "after-allreduce-sum" in out
+
+
+def test_broadcast_py_solo():
+    out = run_solo([sys.executable, str(GUIDE / "broadcast.py")])
+    assert "'hello world': 100" in out
+
+
+def test_basic_py_cluster():
+    cluster = LocalCluster(3, quiet=True)
+    rc = cluster.run(
+        [sys.executable, str(GUIDE / "basic.py"), "rabit_engine=robust"],
+        timeout=60,
+    )
+    assert rc == 0
+
+
+def test_lazy_allreduce_py_mock_failure():
+    """The reference's fault-injection demo: worker 0 dies at its first
+    collective, restarts, and recovers (doc/guide.md:312-331)."""
+    cluster = LocalCluster(3, max_restarts=3, quiet=True)
+    rc = cluster.run(
+        [
+            sys.executable,
+            str(GUIDE / "lazy_allreduce.py"),
+            "rabit_engine=mock",
+            "mock=0,0,0,0",
+        ],
+        timeout=90,
+    )
+    assert rc == 0
+    assert cluster.restarts[0] == 1
+
+
+# --- C++ examples ----------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def cpp_examples() -> Path:
+    proc = subprocess.run(
+        ["make", "-C", str(GUIDE), "-j4"], capture_output=True, text=True
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    return GUIDE
+
+
+def test_basic_cc_solo(cpp_examples):
+    out = run_solo([str(cpp_examples / "basic.run")])
+    assert "after-allreduce-sum: a={0, 1, 2}" in out
+
+
+def test_basic_cc_cluster(cpp_examples):
+    cluster = LocalCluster(4, quiet=True)
+    rc = cluster.run(
+        [str(cpp_examples / "basic.run"), "rabit_engine=robust"], timeout=60
+    )
+    assert rc == 0
+
+
+def test_broadcast_cc_cluster(cpp_examples):
+    cluster = LocalCluster(3, quiet=True)
+    rc = cluster.run(
+        [str(cpp_examples / "broadcast.run"), "rabit_engine=robust"],
+        timeout=60,
+    )
+    assert rc == 0
+
+
+def test_lazy_allreduce_cc_mock_failure(cpp_examples):
+    cluster = LocalCluster(3, max_restarts=3, quiet=True)
+    rc = cluster.run(
+        [
+            str(cpp_examples / "lazy_allreduce.run"),
+            "rabit_engine=mock",
+            "mock=1,0,0,0",
+        ],
+        timeout=90,
+    )
+    assert rc == 0
+    assert cluster.restarts[1] == 1

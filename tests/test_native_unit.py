@@ -1,0 +1,40 @@
+"""Wrappers running the native C++ test binaries (reference tiers: test/cpp
+unit tests and test/speed_test.cc) from pytest so one command covers all
+tiers."""
+
+from __future__ import annotations
+
+import subprocess
+from pathlib import Path
+
+import pytest
+
+from rabit_tpu.tracker.launcher import LocalCluster
+
+NATIVE = Path(__file__).resolve().parents[1] / "native"
+
+
+def build(target: str) -> Path:
+    proc = subprocess.run(
+        ["make", "-C", str(NATIVE), target], capture_output=True, text=True
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    return NATIVE / target
+
+
+def test_cpp_unit_tests():
+    binary = build("tests/unit_tests.run")
+    proc = subprocess.run([str(binary)], capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "0 failed" in proc.stdout
+
+
+@pytest.mark.parametrize("engine", ["base", "robust"])
+def test_speed_test_cluster(engine):
+    binary = build("tests/speed_test.run")
+    cluster = LocalCluster(4, quiet=True)
+    rc = cluster.run(
+        [str(binary), "ndata=4096", "nrep=3", f"rabit_engine={engine}"],
+        timeout=60,
+    )
+    assert rc == 0

@@ -90,6 +90,15 @@ def test_local_checkpoint_recovery():
     assert cluster.restarts[2] == 1
 
 
+def test_local_model_zero_replicas():
+    """rabit_local_replica=0: local models are checkpointed but not
+    replicated — valid config, must not trip the consistency check."""
+    cluster = run_cluster(
+        4, ["niter=3", "local=1", "rabit_local_replica=0"], max_restarts=0
+    )
+    assert cluster.restarts == [0, 0, 0, 0]
+
+
 def test_local_checkpoint_double_death():
     """Two deaths with local models: replicas must still cover both."""
     run_cluster(5, ["niter=4", "local=1", "mock=1,2,3,0;3,2,3,0"])
@@ -148,6 +157,14 @@ def test_death_in_commit_window():
 
 def test_death_in_commit_window_global_only():
     run_cluster(4, ["niter=3", "mock=2,2,-3,0"])
+
+
+def test_staggered_overlapping_recoveries():
+    """Two ranks die at different seqnos of the same version so one is
+    still catching up (replaying seqnos) while the other is being served
+    its checkpoint / syncing through the ack barrier — the window where
+    the seqno election must ignore ack-barrier ranks' reset seqno."""
+    run_cluster(5, ["niter=4", "mock=1,1,1,0;2,1,3,0"])
 
 
 def test_many_iterations_many_deaths():

@@ -1,0 +1,50 @@
+#!/usr/bin/env python
+"""Sweep the native collective micro-benchmark over payload sizes and world
+sizes (parity with /root/reference/test/speed_runner.py's 10^4-10^7 float x
+host grid, run as local processes instead of a hostfile cluster).
+
+    python tools/speed_runner.py [--engines base,robust] [--workers 2,4,8]
+"""
+
+from __future__ import annotations
+
+import argparse
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO))
+
+from rabit_tpu.tracker.launcher import LocalCluster  # noqa: E402
+
+BIN = REPO / "native" / "tests" / "speed_test.run"
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--engines", default="base,robust")
+    ap.add_argument("--workers", default="2,4,8")
+    ap.add_argument("--sizes", default="10000,100000,1000000,10000000")
+    ap.add_argument("--nrep", type=int, default=10)
+    args = ap.parse_args()
+
+    subprocess.run(
+        ["make", "-C", str(REPO / "native"), "tests/speed_test.run"], check=True
+    )
+    for engine in args.engines.split(","):
+        for nworkers in map(int, args.workers.split(",")):
+            for ndata in map(int, args.sizes.split(",")):
+                print(f"== engine={engine} workers={nworkers} ndata={ndata}",
+                      flush=True)
+                cluster = LocalCluster(nworkers, quiet=True)
+                cluster.run(
+                    [str(BIN), f"ndata={ndata}", f"nrep={args.nrep}",
+                     f"rabit_engine={engine}"],
+                    timeout=600,
+                )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
