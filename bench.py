@@ -78,22 +78,23 @@ def bench_tpu(xb, y):
     import jax.numpy as jnp
 
     from rabit_tpu.models import gbdt
+    from rabit_tpu.ops import boost
 
     cfg = gbdt.GBDTConfig(
         n_features=N_FEATURES, n_trees=TPU_ROUNDS + 2, depth=DEPTH,
         n_bins=N_BINS, learning_rate=LR, reg_lambda=LAM,
     )
-    step = jax.jit(functools.partial(gbdt.train_round, cfg=cfg), donate_argnums=0)
-    xb_d = jnp.asarray(xb)
+    step = jax.jit(functools.partial(gbdt.train_round_fused, cfg=cfg), donate_argnums=0)
+    xb3, _ = boost.block_rows(jnp.asarray(xb))
     y_d = jnp.asarray(y)
     state = gbdt.init_state(cfg, N_ROWS)
-    state = step(state, xb_d, y_d)  # compile + warm
+    state = step(state, xb3, y_d)  # compile + warm
     # block_until_ready does not actually fence on the axon relay platform;
     # a host readback of a small output does.
     jax.device_get(state.forest.leaf)
     t0 = time.perf_counter()
     for _ in range(TPU_ROUNDS):
-        state = step(state, xb_d, y_d)
+        state = step(state, xb3, y_d)
     jax.device_get(state.forest.leaf)
     return (time.perf_counter() - t0) / TPU_ROUNDS
 

@@ -43,6 +43,16 @@ namespace tpurabit {
 #define TPURABIT_CALLER "N/A"
 #endif
 
+#ifndef TPURABIT_ERROR_DEFINED
+#define TPURABIT_ERROR_DEFINED
+/// Thrown by every failing call in this header (mirroring the reference,
+/// where utils::Check throws dmlc::Error straight through rabit.h calls).
+class Error : public std::runtime_error {
+ public:
+  explicit Error(const std::string& msg) : std::runtime_error(msg) {}
+};
+#endif
+
 // ---------------------------------------------------------------------------
 // Streams + Serializable (reference: serializable.h re-exporting dmlc::
 // Stream/Serializable; internal/io.h MemoryFixSizeBuffer/MemoryBufferStream).
@@ -72,6 +82,8 @@ class MemoryFixSizeBuffer : public Stream {
   }
   void Write(const void* ptr, size_t size) override {
     if (size == 0) return;
+    if (pos_ + size > size_)
+      throw Error("MemoryFixSizeBuffer: write past end of fixed buffer");
     std::memcpy(p_ + pos_, ptr, size);
     pos_ += size;
   }
@@ -119,17 +131,8 @@ class Serializable {
 
 // ---------------------------------------------------------------------------
 // Error handling: the C ABI reports via return code + message; the C++
-// layer re-raises (mirroring the reference, where utils::Check throws
-// dmlc::Error straight through rabit.h calls).
+// layer re-raises as Error (defined above the stream classes).
 // ---------------------------------------------------------------------------
-
-#ifndef TPURABIT_ERROR_DEFINED
-#define TPURABIT_ERROR_DEFINED
-class Error : public std::runtime_error {
- public:
-  explicit Error(const std::string& msg) : std::runtime_error(msg) {}
-};
-#endif
 
 namespace detail {
 inline void Check(int rc, const char* what) {
@@ -393,10 +396,15 @@ inline void LazyCheckPoint(const Serializable* global_model) {
   std::string next;
   MemoryBufferStream fs(&next);
   global_model->Save(&fs);
-  // Swap only after the engine releases the previous pointer.
-  detail::Check(RabitLazyCheckPoint(next.data(), next.size()),
-                "LazyCheckPoint");
+  // Swap into the thread-local BEFORE registering: the engine must get a
+  // pointer that outlives this call.  For short (SSO) strings swap copies
+  // between in-object buffers, so next.data() would dangle at return;
+  // blob.data() is stable until the next LazyCheckPoint.  The engine is
+  // single-threaded per the API contract, so it cannot dereference the
+  // previous pointer between the swap and the call below.
   blob.swap(next);
+  detail::Check(RabitLazyCheckPoint(blob.data(), blob.size()),
+                "LazyCheckPoint");
 }
 
 /// Checkpoint version = number of CheckPoint calls so far.

@@ -1,0 +1,14 @@
+"""TPU-native hot ops (the compute the reference never had to do itself —
+rabit's only numeric kernel is the CPU reducer callback at
+/root/reference/src/allreduce_base.cc:566-605; on TPU the framework owns
+the workload kernels too, so they live here as first-class ops).
+"""
+
+from rabit_tpu.ops.hist import (  # noqa: F401
+    node_histograms,
+    node_histograms_onehot,
+    node_histograms_pallas,
+    node_histograms_scatter,
+    segment_sum,
+    segment_sum_matmul,
+)

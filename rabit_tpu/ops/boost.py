@@ -1,0 +1,267 @@
+"""Fused Pallas kernels for one GBDT boosting round on TPU.
+
+The hook-based ``models.gbdt.train_round`` makes one pass over the rows per
+level for histograms plus separate passes for routing, leaf fit, and margin
+update — each a round-trip through HBM.  These kernels fuse a level's work
+into a single streaming pass per row block:
+
+* ``hist_level0``   — histogram at the root (no routing needed).
+* ``hist_level``    — route rows one level down through the parent split
+  table (split lookup + feature select + compare, all in VMEM) and
+  histogram at the new nodes, emitting the updated node ids as a second
+  output.
+* ``leaf_fit``      — route to the leaves and reduce per-leaf (g, h) mass
+  with the same MXU contraction, emitting final leaf assignments.
+
+The histogram itself is the one-hot MXU contraction of ``ops.hist``: the
+row block's gradient matrix L (one g column + one h column per node) is
+contracted against per-feature bin indicators built in VMEM; f32 gradients
+are split hi/lo into two bfloat16 matmuls (error ~2^-16-relative).
+
+All wrappers take pre-blocked arrays (nb, R, ...) so padding/reshaping
+happens once per fit, not once per level.  ``interpret=True`` runs the
+kernels in the Pallas interpreter, which is how the CPU test suite checks
+them against the reference ``train_round`` (tests/test_gbdt.py).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+_DN = (((0,), (0,)), ((), ()))  # contract dim 0 vs dim 0
+
+
+def _round_up(x: int, m: int) -> int:
+    return -(-x // m) * m
+
+
+def _bins_eff(n_bins: int) -> int:
+    """Mask width per feature: bins padded to full 128-lane registers (the
+    pad columns never match a bin id, so they stay zero)."""
+    return _round_up(n_bins, 128)
+
+
+def _pick_fc(n_feat: int, n_bins: int) -> int:
+    """Features per matmul group (N = fc * bins_eff ~ 1792 lanes)."""
+    return min(n_feat, max(1, 1792 // _bins_eff(n_bins)))
+
+
+def _accumulate_hist(xb_blk, L, out_ref, *, n_bins: int, n_feat: int, fc: int):
+    """out_ref[m, f*Beff+b] += sum_r L[r, m] * [xb_blk[r, f] == b]."""
+    be = _bins_eff(n_bins)
+    lhi = L.astype(jnp.bfloat16)
+    llo = (L - lhi.astype(jnp.float32)).astype(jnp.bfloat16)
+    r = xb_blk.shape[0]
+    b_iota = lax.broadcasted_iota(jnp.int32, (r, be), 1)
+    for gi in range(0, n_feat, fc):
+        k = min(fc, n_feat - gi)
+        onehot = jnp.concatenate(
+            [(xb_blk[:, f : f + 1] == b_iota) for f in range(gi, gi + k)],
+            axis=1,
+        ).astype(jnp.bfloat16)
+        acc = lax.dot_general(lhi, onehot, _DN, preferred_element_type=jnp.float32)
+        acc += lax.dot_general(llo, onehot, _DN, preferred_element_type=jnp.float32)
+        out_ref[:, gi * be : (gi + k) * be] += acc
+
+
+def _gradient_matrix(node, g, h, *, n_nodes: int, m_pad: int):
+    """L[r, m]: g_r at column node_r, h_r at column n_nodes+node_r."""
+    r = node.shape[0]
+    m_iota = lax.broadcasted_iota(jnp.int32, (r, m_pad), 1)
+    is_g = m_iota < n_nodes
+    idx = jnp.where(is_g, m_iota, m_iota - n_nodes)
+    sel = (node == idx) & (m_iota < 2 * n_nodes)
+    val = jnp.where(is_g, g, h)  # (R,1) -> (R, m_pad)
+    return jnp.where(sel, val, 0.0)
+
+
+def _route(xb_blk, node, feat_row, thr_row, *, p_pad: int, n_feat: int):
+    """node' = 2*node + [x[feat[node]] > thr[node]] — split-table lookup and
+    feature select via lane-masked reductions (no gathers)."""
+    r = node.shape[0]
+    p_iota = lax.broadcasted_iota(jnp.int32, (r, p_pad), 1)
+    pm = node == p_iota  # (R, P) one-hot over parent nodes
+    fsel = jnp.sum(jnp.where(pm, feat_row, 0), axis=1, keepdims=True)
+    tsel = jnp.sum(jnp.where(pm, thr_row, 0), axis=1, keepdims=True)
+    f_iota = lax.broadcasted_iota(jnp.int32, (r, n_feat), 1)
+    xv = jnp.sum(jnp.where(f_iota == fsel, xb_blk, 0), axis=1, keepdims=True)
+    return node * 2 + (xv > tsel).astype(jnp.int32)
+
+
+# -- level 0: histogram at the root ----------------------------------------
+
+
+def _level0_kernel(xb_ref, g_ref, h_ref, out_ref, *, n_bins, n_feat, fc):
+    @pl.when(pl.program_id(0) == 0)
+    def _init():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    r = g_ref.shape[1]
+    node = jnp.zeros((r, 1), jnp.int32)
+    L = _gradient_matrix(node, g_ref[0], h_ref[0], n_nodes=1, m_pad=8)
+    _accumulate_hist(xb_ref[0], L, out_ref, n_bins=n_bins, n_feat=n_feat, fc=fc)
+
+
+# -- level d >= 1: route + histogram ---------------------------------------
+
+
+def _level_kernel(xb_ref, node_ref, g_ref, h_ref, feat_ref, thr_ref,
+                  out_ref, node_out_ref, *,
+                  n_nodes, n_bins, n_feat, m_pad, p_pad, fc):
+    @pl.when(pl.program_id(0) == 0)
+    def _init():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    node = _route(xb_ref[0], node_ref[0], feat_ref[0:1], thr_ref[0:1],
+                  p_pad=p_pad, n_feat=n_feat)
+    node_out_ref[0] = node
+    L = _gradient_matrix(node, g_ref[0], h_ref[0], n_nodes=n_nodes, m_pad=m_pad)
+    _accumulate_hist(xb_ref[0], L, out_ref, n_bins=n_bins, n_feat=n_feat, fc=fc)
+
+
+# -- leaf fit: route + per-leaf (g, h) mass --------------------------------
+
+
+def _leaf_kernel(xb_ref, node_ref, g_ref, h_ref, feat_ref, thr_ref,
+                 out_ref, node_out_ref, *, n_leaves, n_feat, m_pad, p_pad):
+    @pl.when(pl.program_id(0) == 0)
+    def _init():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    node = _route(xb_ref[0], node_ref[0], feat_ref[0:1], thr_ref[0:1],
+                  p_pad=p_pad, n_feat=n_feat)
+    node_out_ref[0] = node
+    L = _gradient_matrix(node, g_ref[0], h_ref[0], n_nodes=n_leaves, m_pad=m_pad)
+    lhi = L.astype(jnp.bfloat16)
+    llo = (L - lhi.astype(jnp.float32)).astype(jnp.bfloat16)
+    ones = jnp.ones((L.shape[0], 128), jnp.bfloat16)
+    acc = lax.dot_general(lhi, ones, _DN, preferred_element_type=jnp.float32)
+    acc += lax.dot_general(llo, ones, _DN, preferred_element_type=jnp.float32)
+    out_ref[:] += acc
+
+
+# -- host wrappers (pre-blocked (nb, R, .) arrays) -------------------------
+
+
+_blk = lambda R, k: pl.BlockSpec((1, R, k), lambda i: (i, 0, 0))
+
+
+@functools.partial(jax.jit, static_argnames=("n_bins", "interpret"))
+def hist_level0(xb3, g3, h3, *, n_bins: int, interpret: bool = False):
+    """Root histogram; [1, F, B, 2]."""
+    nb, R, F = xb3.shape
+    be = _bins_eff(n_bins)
+    fc = _pick_fc(F, n_bins)
+    out = pl.pallas_call(
+        functools.partial(_level0_kernel, n_bins=n_bins, n_feat=F, fc=fc),
+        grid=(nb,),
+        in_specs=[_blk(R, F), _blk(R, 1), _blk(R, 1)],
+        out_specs=pl.BlockSpec((8, F * be), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((8, F * be), jnp.float32),
+        interpret=interpret,
+    )(xb3, g3, h3)
+    out = out.reshape(8, F, be)[..., :n_bins]
+    return jnp.stack([out[0:1], out[1:2]], axis=-1)
+
+
+@functools.partial(jax.jit, static_argnames=("depth", "n_bins", "interpret"))
+def hist_level(xb3, node3, g3, h3, feat, thr, *, depth: int, n_bins: int,
+               interpret: bool = False):
+    """Route one level down and histogram; returns
+    ([2**depth, F, B, 2], node3').  ``feat``/``thr`` are the level-(depth-1)
+    split tables, shape [2**(depth-1)]."""
+    nb, R, F = xb3.shape
+    be = _bins_eff(n_bins)
+    n_nodes = 2 ** depth
+    n_prev = 2 ** (depth - 1)
+    m_pad = _round_up(2 * n_nodes, 8)
+    p_pad = _round_up(n_prev, 128)
+    fc = _pick_fc(F, n_bins)
+    featp = jnp.zeros((8, p_pad), jnp.int32).at[0, :n_prev].set(feat)
+    thrp = jnp.zeros((8, p_pad), jnp.int32).at[0, :n_prev].set(thr)
+    out, node_out = pl.pallas_call(
+        functools.partial(
+            _level_kernel, n_nodes=n_nodes, n_bins=n_bins, n_feat=F,
+            m_pad=m_pad, p_pad=p_pad, fc=fc,
+        ),
+        grid=(nb,),
+        in_specs=[
+            _blk(R, F), _blk(R, 1), _blk(R, 1), _blk(R, 1),
+            pl.BlockSpec((8, p_pad), lambda i: (0, 0)),
+            pl.BlockSpec((8, p_pad), lambda i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((m_pad, F * be), lambda i: (0, 0)),
+            _blk(R, 1),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((m_pad, F * be), jnp.float32),
+            jax.ShapeDtypeStruct((nb, R, 1), jnp.int32),
+        ],
+        interpret=interpret,
+    )(xb3, node3, g3, h3, featp, thrp)
+    out = out.reshape(m_pad, F, be)[..., :n_bins]
+    hist = jnp.stack([out[:n_nodes], out[n_nodes : 2 * n_nodes]], axis=-1)
+    return hist, node_out
+
+
+@functools.partial(jax.jit, static_argnames=("depth", "interpret"))
+def leaf_fit(xb3, node3, g3, h3, feat, thr, *, depth: int,
+             interpret: bool = False):
+    """Route to leaves and sum (g, h) per leaf; returns
+    ([2**depth, 2], leaf_node3).  ``feat``/``thr`` are the level-(depth-1)
+    split tables."""
+    nb, R, F = xb3.shape
+    n_leaves = 2 ** depth
+    n_prev = 2 ** (depth - 1)
+    m_pad = _round_up(2 * n_leaves, 128)  # also the dummy N dim of the matmul
+    p_pad = _round_up(n_prev, 128)
+    featp = jnp.zeros((8, p_pad), jnp.int32).at[0, :n_prev].set(feat)
+    thrp = jnp.zeros((8, p_pad), jnp.int32).at[0, :n_prev].set(thr)
+    out, node_out = pl.pallas_call(
+        functools.partial(
+            _leaf_kernel, n_leaves=n_leaves, n_feat=F, m_pad=m_pad, p_pad=p_pad,
+        ),
+        grid=(nb,),
+        in_specs=[
+            _blk(R, F), _blk(R, 1), _blk(R, 1), _blk(R, 1),
+            pl.BlockSpec((8, p_pad), lambda i: (0, 0)),
+            pl.BlockSpec((8, p_pad), lambda i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((m_pad, 128), lambda i: (0, 0)),
+            _blk(R, 1),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((m_pad, 128), jnp.float32),
+            jax.ShapeDtypeStruct((nb, R, 1), jnp.int32),
+        ],
+        interpret=interpret,
+    )(xb3, node3, g3, h3, featp, thrp)
+    gh = out[:, 0]
+    return jnp.stack([gh[:n_leaves], gh[n_leaves : 2 * n_leaves]], axis=-1), node_out
+
+
+# -- blocking helpers -------------------------------------------------------
+
+
+def block_rows(x, block: int = 1024):
+    """Pad a [n, ...] array with zeros to a block multiple and reshape to
+    (nb, block, k) for the fused kernels.  Returns (blocked, n)."""
+    n = x.shape[0]
+    n_pad = _round_up(n, block)
+    if x.ndim == 1:
+        x = x[:, None]
+    if n_pad != n:
+        x = jnp.pad(x, ((0, n_pad - n), (0, 0)))
+    return x.reshape(n_pad // block, block, x.shape[1]), n
+
+
+def unblock_rows(x3, n: int):
+    """Inverse of block_rows for [nb, R, 1] -> [n]."""
+    return x3.reshape(-1)[:n] if x3.shape[-1] == 1 else x3.reshape(-1, x3.shape[-1])[:n]

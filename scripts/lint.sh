@@ -1,0 +1,10 @@
+#!/usr/bin/env bash
+# Static checks (the reference's lint step): bytecode-compile every Python
+# file and run native build with warnings-as-errors.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+python -m compileall -q rabit_tpu tests guide tools bench.py __graft_entry__.py
+make -C native clean > /dev/null
+make -C native CXXFLAGS="-O2 -std=c++17 -fPIC -Wall -Wextra -Wno-unused-parameter -Werror" > /dev/null
+echo "lint OK"

@@ -1,0 +1,9 @@
+#!/usr/bin/env bash
+# Full test gate (the reference's scripts/travis_script.sh + travis_runtest.sh
+# role): native build + unit tests, Python suite (includes the kill-and-recover
+# scenario matrix under the local tracker), and guide smoke tests.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+make -C native test
+python -m pytest tests/ -q "$@"

@@ -129,3 +129,62 @@ def test_sharded_training_matches_single(use_fp):
     np.testing.assert_array_equal(got_forest.threshold, ref_forest.threshold)
     np.testing.assert_allclose(got_forest.leaf, ref_forest.leaf, rtol=1e-4)
     np.testing.assert_allclose(np.asarray(sstate.margin), ref_margin, rtol=1e-4)
+
+
+def test_train_round_fused_matches_reference():
+    """The fused Pallas round (ops.boost, run via the Pallas interpreter on
+    CPU) must grow the exact same trees as the hook-based train_round."""
+    from rabit_tpu.ops import boost
+
+    rng = np.random.RandomState(3)
+    n, f = 600, 5
+    cfg = gbdt.GBDTConfig(n_features=f, n_trees=3, depth=3, n_bins=16)
+    xb = jnp.asarray(rng.randint(0, cfg.n_bins, size=(n, f)), jnp.int32)
+    y = jnp.asarray(rng.randint(0, 2, size=n), jnp.float32)
+    xb3, _ = boost.block_rows(xb, 256)
+
+    ref_step = jax.jit(functools.partial(gbdt.train_round, cfg=cfg))
+    fused_step = functools.partial(gbdt.train_round_fused, cfg=cfg, interpret=True)
+    s_ref = gbdt.init_state(cfg, n)
+    s_f = gbdt.init_state(cfg, n)
+    for _ in range(cfg.n_trees):
+        s_ref = ref_step(s_ref, xb, y)
+        s_f = fused_step(s_f, xb3, y)
+
+    fr = jax.tree.map(np.asarray, s_ref.forest)
+    ff = jax.tree.map(np.asarray, s_f.forest)
+    np.testing.assert_array_equal(ff.feature, fr.feature)
+    np.testing.assert_array_equal(ff.threshold, fr.threshold)
+    # hi/lo-bf16 leaf sums carry ~2^-16-relative error vs the exact-f32 path
+    np.testing.assert_allclose(ff.leaf, fr.leaf, rtol=1e-3, atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(s_f.margin), np.asarray(s_ref.margin), rtol=1e-3, atol=1e-5
+    )
+
+
+def test_hist_impls_agree():
+    """scatter / onehot histogram implementations agree to f32 accuracy."""
+    from rabit_tpu.ops import hist as H
+
+    rng = np.random.RandomState(1)
+    n, F, B, nn = 500, 4, 16, 4
+    xb = jnp.asarray(rng.randint(0, B, size=(n, F)), jnp.int32)
+    g = jnp.asarray(rng.randn(n), jnp.float32)
+    h = jnp.asarray(rng.rand(n), jnp.float32)
+    node = jnp.asarray(rng.randint(0, nn, size=n), jnp.int32)
+    ref = np.asarray(H.node_histograms_scatter(xb, g, h, node, nn, B))
+    got = np.asarray(H.node_histograms_onehot(xb, g, h, node, nn, B))
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+    # the TPU-default Pallas kernel, via the interpreter
+    got_p = np.asarray(
+        H.node_histograms_pallas(xb, g, h, node, nn, B, block_rows=256,
+                                 interpret=True)
+    )
+    np.testing.assert_allclose(got_p, ref, rtol=1e-4, atol=1e-4)
+    # and the leaf-fit segment_sum matmul path
+    vals = jnp.stack([g, h], -1)
+    np.testing.assert_allclose(
+        np.asarray(H.segment_sum(vals, node, nn, impl="matmul")),
+        np.asarray(H.segment_sum(vals, node, nn, impl="scatter")),
+        rtol=1e-5, atol=1e-5,
+    )
