@@ -1,5 +1,7 @@
 """Model families built on the framework's collectives."""
 
+from rabit_tpu.models.kmeans import KMeans, KMeansConfig
+from rabit_tpu.models.linear import LinearConfig, LinearModel, LinearState
 from rabit_tpu.models.gbdt import (
     GBDT,
     GBDTConfig,
@@ -15,6 +17,11 @@ from rabit_tpu.models.gbdt import (
 )
 
 __all__ = [
+    "KMeans",
+    "KMeansConfig",
+    "LinearConfig",
+    "LinearModel",
+    "LinearState",
     "GBDT",
     "GBDTConfig",
     "Forest",

@@ -1,0 +1,119 @@
+"""Distributed k-means (Lloyd's algorithm) — the reference tutorial's
+"exercise" workload (doc/guide.md asks the reader to build exactly this on
+rabit): each worker assigns its row shard to the nearest centroid and one
+Allreduce(SUM) of the [K, F+1] (cluster sums ++ counts) statistics matrix
+per iteration re-estimates the centroids.
+
+TPU-first shape: assignment is one ``X @ C.T`` matmul (MXU) plus a row
+argmin; the per-cluster sums use the one-hot-matmul ``segment_sum`` from
+``rabit_tpu.ops`` (scatter-free on TPU); the combine hook is the only
+communication point (psum under shard_map, or the engine's host allreduce
+in the rabit-classic deployment).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class KMeansConfig(NamedTuple):
+    n_clusters: int
+    n_iters: int = 20
+
+
+def assign(X: jax.Array, centers: jax.Array) -> jax.Array:
+    """Nearest-centroid ids, [n].  argmin ||x - c||^2 = argmin c.c - 2 x.c
+    (the x.x term is constant per row) — one MXU matmul, no pairwise
+    distance tensor."""
+    cc = jnp.sum(centers * centers, axis=1)  # [K]
+    scores = cc[None, :] - 2.0 * (X @ centers.T)  # [n, K]
+    return jnp.argmin(scores, axis=1).astype(jnp.int32)
+
+
+def local_stats(X: jax.Array, centers: jax.Array) -> jax.Array:
+    """Per-shard [K, F + 1] matrix: per-cluster feature sums ++ counts."""
+    from rabit_tpu.ops import hist as _hist
+
+    k = centers.shape[0]
+    a = assign(X, centers)
+    ones = jnp.ones((X.shape[0], 1), jnp.float32)
+    vals = jnp.concatenate([X, ones], axis=1)  # [n, F+1]
+    return _hist.segment_sum(vals, a, k)
+
+
+def update(centers: jax.Array, stats: jax.Array) -> jax.Array:
+    """New centroids from summed stats; empty clusters keep their centroid."""
+    counts = stats[:, -1:]
+    return jnp.where(counts > 0, stats[:, :-1] / jnp.maximum(counts, 1.0), centers)
+
+
+def train_iter(centers: jax.Array, X: jax.Array,
+               combine: Callable[[jax.Array], jax.Array] = lambda x: x) -> jax.Array:
+    return update(centers, combine(local_stats(X, centers)))
+
+
+def train_iter_dp(centers, X, axis: str = "dp"):
+    return train_iter(centers, X, combine=lambda v: jax.lax.psum(v, axis))
+
+
+def inertia(X: jax.Array, centers: jax.Array) -> jax.Array:
+    a = assign(X, centers)
+    d = X - centers[a]
+    return jnp.sum(d * d)
+
+
+class KMeans:
+    """Numpy-in trainer; ``engine_allreduce`` switches on the rabit-classic
+    multi-process deployment (only the [K, F+1] stats matrix crosses the
+    engine each iteration)."""
+
+    def __init__(self, n_clusters: int, n_iters: int = 20,
+                 engine_allreduce: Callable[[np.ndarray], np.ndarray] | None = None,
+                 seed: int = 0):
+        self.cfg = KMeansConfig(n_clusters=n_clusters, n_iters=n_iters)
+        self._engine_allreduce = engine_allreduce
+        self._seed = seed
+        self.centers: np.ndarray | None = None
+
+    def fit(self, X: np.ndarray, init_centers: np.ndarray | None = None,
+            start_iter: int = 0):
+        X = jnp.asarray(np.asarray(X, np.float32))
+        if init_centers is None:
+            if self._engine_allreduce is not None:
+                # Workers hold different shards: seeding from the local shard
+                # would give every worker different centers and the summed
+                # stats would be incoherent.  Agree on an init first
+                # (e.g. rabit_tpu.api.broadcast rank 0's choice).
+                raise ValueError(
+                    "distributed KMeans needs an agreed init_centers "
+                    "(broadcast one from rank 0)"
+                )
+            rng = np.random.RandomState(self._seed)
+            idx = rng.choice(X.shape[0], self.cfg.n_clusters, replace=False)
+            centers = jnp.asarray(np.asarray(X)[idx])
+        else:
+            centers = jnp.asarray(np.asarray(init_centers, np.float32))
+        if self._engine_allreduce is None:
+            it = jax.jit(train_iter)
+            for _ in range(start_iter, self.cfg.n_iters):
+                centers = it(centers, X)
+        else:
+            stats = jax.jit(local_stats)
+            upd = jax.jit(update)
+            for _ in range(start_iter, self.cfg.n_iters):
+                s = self._engine_allreduce(np.asarray(stats(X, centers)))
+                centers = upd(centers, jnp.asarray(s))
+        self.centers = np.asarray(centers)
+        return self
+
+    def predict(self, X: np.ndarray) -> np.ndarray:
+        return np.asarray(assign(jnp.asarray(np.asarray(X, np.float32)),
+                                 jnp.asarray(self.centers)))
+
+    def inertia(self, X: np.ndarray) -> float:
+        return float(inertia(jnp.asarray(np.asarray(X, np.float32)),
+                             jnp.asarray(self.centers)))

@@ -1,0 +1,41 @@
+"""Distributed GBDT kill-and-recover: the flagship workload trained under
+the local tracker with the mock engine's deterministic fault injection —
+the TPU build's equivalent of running distributed XGBoost on rabit and
+killing workers mid-boost (reference test/test.mk + doc/guide.md:130-140).
+
+Per-version collective layout (gbdt_worker.py): seq 0..2 = level histogram
+allreduces, seq 3 = leaf allreduce."""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+from rabit_tpu.tracker.launcher import LocalCluster
+
+WORKER = str(Path(__file__).parent / "workers" / "gbdt_worker.py")
+
+
+def run_cluster(nworkers, worker_args, max_restarts=10, timeout=300.0):
+    cmd = [sys.executable, WORKER, "rabit_engine=mock", *worker_args]
+    cluster = LocalCluster(nworkers, max_restarts=max_restarts, quiet=True)
+    assert cluster.run(cmd, timeout=timeout) == 0
+    assert all(rc == 0 for rc in cluster.returncodes)
+    return cluster
+
+
+def test_gbdt_no_failure():
+    run_cluster(4, ["ntrees=3"])
+
+
+def test_gbdt_death_mid_boost():
+    """Rank 1 dies at the level-1 histogram allreduce of the second tree;
+    it must reload the 1-tree forest from peers, re-derive its shard
+    margin, and the final forests must still match everywhere."""
+    run_cluster(4, ["ntrees=4", "mock=1,1,1,0"])
+
+
+def test_gbdt_death_at_leaf_and_restart_death():
+    """One death at a leaf allreduce plus a second death on the restarted
+    life (die-hard pattern) in a later tree."""
+    run_cluster(4, ["ntrees=4", "mock=2,0,3,0;2,2,0,1"])

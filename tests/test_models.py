@@ -1,0 +1,147 @@
+"""Linear-model and k-means family tests: learning quality, dp (shard_map)
+training matching single-shard training, and the rabit-classic
+engine-allreduce deployment matching both."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from rabit_tpu import parallel as rp
+from rabit_tpu.models import kmeans, linear
+
+
+def make_classif(n=1600, f=6, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f).astype(np.float32)
+    w = rng.randn(f).astype(np.float32)
+    y = (X @ w + 0.3 > 0).astype(np.float32)
+    return X, y
+
+
+# -- linear ----------------------------------------------------------------
+
+
+def test_linear_learns():
+    X, y = make_classif()
+    m = linear.LinearModel(n_steps=80).fit(X, y)
+    assert (m.predict(X) == y).mean() > 0.95
+
+
+def test_linear_dp_matches_single():
+    X, y = make_classif()
+    cfg = linear.LinearConfig(n_features=X.shape[1], n_steps=30)
+    single = linear.init_state(cfg)
+    step = jax.jit(functools.partial(linear.train_step, cfg=cfg))
+    for _ in range(cfg.n_steps):
+        single = step(single, jnp.asarray(X), jnp.asarray(y))
+
+    mesh = rp.create_mesh(("dp",))
+    dstep = jax.jit(
+        jax.shard_map(
+            functools.partial(linear.train_step_dp, cfg=cfg),
+            mesh=mesh,
+            in_specs=(linear.LinearState(P(), P()), P("dp", None), P("dp")),
+            out_specs=linear.LinearState(P(), P()),
+            check_vma=False,
+        )
+    )
+    sharded = linear.init_state(cfg)
+    for _ in range(cfg.n_steps):
+        sharded = dstep(sharded, jnp.asarray(X), jnp.asarray(y))
+    np.testing.assert_allclose(
+        np.asarray(sharded.w), np.asarray(single.w), rtol=2e-4, atol=2e-5
+    )
+
+
+def test_linear_engine_hook_matches_single():
+    """Simulate the rabit-classic deployment: W processes each holding a
+    shard, the engine allreduce summed by hand."""
+    X, y = make_classif(n=1200)
+    W = 4
+    shards = [(X[i::W], y[i::W]) for i in range(W)]
+    cfg = dict(n_steps=25)
+
+    single = linear.LinearModel(**cfg).fit(X, y)
+
+    # lockstep: every "worker" contributes its local grad, we sum
+    lcfg = linear.LinearConfig(n_features=X.shape[1], n_steps=25)
+    states = [linear.init_state(lcfg) for _ in range(W)]
+    grad = jax.jit(functools.partial(linear.local_grad, cfg=lcfg))
+    upd = jax.jit(functools.partial(linear.apply_grad, cfg=lcfg))
+    for _ in range(lcfg.n_steps):
+        gsum = sum(
+            np.asarray(grad(states[r].w, jnp.asarray(shards[r][0]), jnp.asarray(shards[r][1])))
+            for r in range(W)
+        )
+        states = [upd(s, jnp.asarray(gsum)) for s in states]
+    for r in range(W):
+        np.testing.assert_allclose(
+            np.asarray(states[r].w), single.w, rtol=2e-3, atol=2e-4
+        )
+
+
+# -- kmeans ----------------------------------------------------------------
+
+
+def make_blobs(n=1500, f=4, k=5, seed=1):
+    rng = np.random.RandomState(seed)
+    centers = rng.randn(k, f).astype(np.float32) * 6
+    a = rng.randint(0, k, size=n)
+    X = centers[a] + rng.randn(n, f).astype(np.float32)
+    return X, centers
+
+
+def test_kmeans_recovers_blobs():
+    X, true_centers = make_blobs()
+    km = kmeans.KMeans(n_clusters=5, n_iters=30, seed=3).fit(X)
+    # every true center has a learned centroid nearby
+    d = np.linalg.norm(true_centers[:, None, :] - km.centers[None, :, :], axis=-1)
+    assert d.min(axis=1).max() < 1.0, d.min(axis=1)
+    # predict is consistent with assignment
+    a = km.predict(X)
+    assert a.shape == (len(X),)
+    assert km.inertia(X) / len(X) < 2 * X.shape[1]
+
+
+def test_kmeans_dp_matches_single():
+    X, _ = make_blobs(n=1600)
+    init = X[:6].copy()
+    single = jnp.asarray(init)
+    it = jax.jit(kmeans.train_iter)
+    for _ in range(10):
+        single = it(single, jnp.asarray(X))
+
+    mesh = rp.create_mesh(("dp",))
+    dit = jax.jit(
+        jax.shard_map(
+            kmeans.train_iter_dp, mesh=mesh,
+            in_specs=(P(), P("dp", None)), out_specs=P(),
+            check_vma=False,
+        )
+    )
+    sharded = jnp.asarray(init)
+    for _ in range(10):
+        sharded = dit(sharded, jnp.asarray(X))
+    np.testing.assert_allclose(np.asarray(sharded), np.asarray(single),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_kmeans_engine_hook_matches_single():
+    X, _ = make_blobs(n=1200)
+    init = X[:4].copy()
+    W = 4
+    shards = [X[i::W] for i in range(W)]
+
+    single = kmeans.KMeans(n_clusters=4, n_iters=8).fit(X, init_centers=init)
+
+    stats = jax.jit(kmeans.local_stats)
+    upd = jax.jit(kmeans.update)
+    centers = jnp.asarray(init)
+    for _ in range(8):
+        s = sum(np.asarray(stats(jnp.asarray(sh), centers)) for sh in shards)
+        centers = upd(centers, jnp.asarray(s))
+    np.testing.assert_allclose(np.asarray(centers), single.centers,
+                               rtol=1e-4, atol=1e-4)
