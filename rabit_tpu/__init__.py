@@ -40,6 +40,8 @@ from rabit_tpu.api import (
     checkpoint,
     lazy_checkpoint,
     version_number,
+    collective_stats,
+    reset_collective_stats,
 )
 
 __version__ = "0.1.0"
@@ -63,4 +65,6 @@ __all__ = [
     "checkpoint",
     "lazy_checkpoint",
     "version_number",
+    "collective_stats",
+    "reset_collective_stats",
 ]

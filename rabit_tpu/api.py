@@ -24,8 +24,22 @@ import numpy as np
 from rabit_tpu.config import Config
 from rabit_tpu.engine import create_engine
 from rabit_tpu.engine.base import MAX, MIN, SUM, BITOR, DTYPE_ENUM, Engine
+import time
+
+from rabit_tpu.profile import GLOBAL_STATS, CollectiveStats, OpStats
 
 _engine: Engine | None = None
+
+
+def collective_stats() -> CollectiveStats:
+    """Accumulated per-collective timing for this process (see
+    rabit_tpu.profile; the Python-layer analogue of the reference's
+    rabit_debug/report_stats observability)."""
+    return GLOBAL_STATS
+
+
+def reset_collective_stats() -> None:
+    GLOBAL_STATS.reset()
 
 
 def _caller_key(depth: int = 2) -> str:
@@ -112,7 +126,12 @@ def broadcast(data: Any, root: int) -> Any:
         if data is None:
             raise ValueError("need to pass in data when broadcasting")
         payload = pickle.dumps(data, protocol=pickle.HIGHEST_PROTOCOL)
+    t0 = time.perf_counter()
     out = engine.broadcast(payload, root, cache_key=key)
+    nbytes = len(payload) if payload is not None else len(out) if out else 0
+    GLOBAL_STATS.ops.setdefault("broadcast", OpStats()).add(
+        nbytes, time.perf_counter() - t0
+    )
     return data if rank == root else pickle.loads(out)
 
 
@@ -140,7 +159,13 @@ def allreduce(
             orig_prepare(data)
             buf_view[...] = np.ascontiguousarray(data).reshape(-1)
 
-    out = _get_engine().allreduce(buf, op, prepare_fun=prepare_fun, cache_key=_caller_key())
+    # NOTE: the timed window includes a lazy prepare_fun's execution (it
+    # runs inside the engine, interleaved with recovery decisions), so
+    # expensive preparation shows up as allreduce latency in the stats.
+    with GLOBAL_STATS.timed("allreduce", buf.nbytes):
+        out = _get_engine().allreduce(
+            buf, op, prepare_fun=prepare_fun, cache_key=_caller_key()
+        )
     return np.asarray(out).reshape(shape)
 
 
@@ -151,7 +176,8 @@ def allgather(data: np.ndarray) -> np.ndarray:
         raise TypeError("allgather only takes numpy ndarrays")
     engine = _get_engine()
     flat = np.ascontiguousarray(data).reshape(-1)
-    out = engine.allgather(flat, cache_key=_caller_key())
+    with GLOBAL_STATS.timed("allgather", flat.nbytes):
+        out = engine.allgather(flat, cache_key=_caller_key())
     return np.asarray(out).reshape((engine.get_world_size(),) + data.shape)
 
 

@@ -304,6 +304,20 @@ def train_round_fused(
     return TrainState(forest=forest, margin=margin, round=t + 1)
 
 
+def train_round_dp_fused(state, xb3, y, cfg, dp_axis: str = "dp",
+                         interpret: bool = False):
+    """train_round_fused wired for shard_map: row blocks sharded over
+    ``dp_axis`` (shard xb3 on its leading block dim, margin/y on rows); one
+    psum per tree level + one for the leaf fit — identical communication
+    placement to train_round_dp, with the fused kernels doing the local
+    work."""
+    return train_round_fused(
+        state, xb3, y, cfg,
+        combine=lambda a: lax.psum(a, dp_axis),
+        interpret=interpret,
+    )
+
+
 # -- prediction ------------------------------------------------------------
 
 

@@ -85,8 +85,9 @@ def ring_attention(
         return (o, m, l, kb, vb), None
 
     o0 = jnp.zeros_like(q, dtype=jnp.float32)  # inherits q's vma
-    m0 = lax.pvary(jnp.full((heads, block), _NEG_INF, dtype=jnp.float32), axis_name)
-    l0 = lax.pvary(jnp.zeros((heads, block), dtype=jnp.float32), axis_name)
+    _vary = lambda x: lax.pcast(x, axis_name, to="varying")
+    m0 = _vary(jnp.full((heads, block), _NEG_INF, dtype=jnp.float32))
+    l0 = _vary(jnp.zeros((heads, block), dtype=jnp.float32))
     # Fold the local block first, then n-1 rotate-and-fold steps.
     kf, vf = k.astype(jnp.float32), v.astype(jnp.float32)
     o, m, l = merge((o0, m0, l0), kf, vf, 0)

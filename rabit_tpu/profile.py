@@ -1,0 +1,91 @@
+"""Tracing / profiling (SURVEY §5 aux subsystems).
+
+The reference's observability is (a) ``rabit_debug=1`` per-op latency log
+lines (allreduce_robust.cc:214-217,289-294) and (b) the mock engine's
+per-checkpoint-interval timing totals (allreduce_mock.h:56-77).  The TPU
+build keeps both ideas at the API layer — every collective is timed into a
+process-wide ``CollectiveStats`` — and adds the TPU-native piece: a thin
+wrapper over the XLA profiler for device traces.
+
+Usage:
+
+    import rabit_tpu as rt
+    ... rt.allreduce(...) ...
+    print(rt.collective_stats().report())   # counts/bytes/latency per op
+
+    from rabit_tpu.profile import xla_trace
+    with xla_trace("/tmp/tb"):              # open in TensorBoard / xprof
+        run_tpu_step()
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from dataclasses import dataclass, field
+
+
+@dataclass
+class OpStats:
+    calls: int = 0
+    nbytes: int = 0
+    seconds: float = 0.0
+    max_seconds: float = 0.0
+
+    def add(self, nbytes: int, seconds: float) -> None:
+        self.calls += 1
+        self.nbytes += nbytes
+        self.seconds += seconds
+        self.max_seconds = max(self.max_seconds, seconds)
+
+
+@dataclass
+class CollectiveStats:
+    """Per-operation accumulated timing, the Python-layer analogue of the
+    mock engine's tsum_allreduce/tsum_allgather counters."""
+
+    ops: dict[str, OpStats] = field(default_factory=dict)
+
+    @contextlib.contextmanager
+    def timed(self, op: str, nbytes: int):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.ops.setdefault(op, OpStats()).add(
+                nbytes, time.perf_counter() - t0
+            )
+
+    def reset(self) -> None:
+        self.ops.clear()
+
+    def report(self) -> str:
+        """One line per op: count, volume, mean/max latency, bandwidth."""
+        lines = []
+        for op in sorted(self.ops):
+            s = self.ops[op]
+            mean_ms = 1e3 * s.seconds / max(s.calls, 1)
+            bw = s.nbytes / s.seconds / 2**20 if s.seconds > 0 else 0.0
+            lines.append(
+                f"{op}: {s.calls} calls, {s.nbytes / 2**20:.2f} MiB, "
+                f"mean {mean_ms:.3f} ms, max {1e3 * s.max_seconds:.3f} ms, "
+                f"{bw:.1f} MiB/s"
+            )
+        return "\n".join(lines) if lines else "(no collectives recorded)"
+
+
+#: process-wide collector used by rabit_tpu.api
+GLOBAL_STATS = CollectiveStats()
+
+
+@contextlib.contextmanager
+def xla_trace(logdir: str):
+    """Capture an XLA device trace for TensorBoard/xprof — the TPU-native
+    replacement for hand-rolled per-link counters."""
+    import jax
+
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

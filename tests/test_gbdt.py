@@ -188,3 +188,54 @@ def test_hist_impls_agree():
         np.asarray(H.segment_sum(vals, node, nn, impl="scatter")),
         rtol=1e-5, atol=1e-5,
     )
+
+
+def test_train_round_dp_fused_matches_dp():
+    """The fused dp round (Pallas interpreter under shard_map on the CPU
+    mesh) must grow the same trees as the hook-based train_round_dp."""
+    from rabit_tpu.ops import boost
+
+    rng = np.random.RandomState(5)
+    ndev = 8
+    n, f = 128 * 2 * ndev, 5  # 2 row blocks of 128 per device
+    cfg = gbdt.GBDTConfig(n_features=f, n_trees=2, depth=3, n_bins=16)
+    xb = jnp.asarray(rng.randint(0, cfg.n_bins, size=(n, f)), jnp.int32)
+    y = jnp.asarray(rng.randint(0, 2, size=n), jnp.float32)
+    mesh = rp.create_mesh(("dp",))
+
+    ref_fn = jax.shard_map(
+        functools.partial(gbdt.train_round_dp, cfg=cfg),
+        mesh=mesh,
+        in_specs=(
+            gbdt.TrainState(forest=gbdt.Forest(P(), P(), P()), margin=P("dp"), round=P()),
+            P("dp", None), P("dp"),
+        ),
+        out_specs=gbdt.TrainState(
+            forest=gbdt.Forest(P(), P(), P()), margin=P("dp"), round=P()
+        ),
+        check_vma=False,
+    )
+    xb3, _ = boost.block_rows(xb, 128)
+    fused_fn = jax.shard_map(
+        functools.partial(gbdt.train_round_dp_fused, cfg=cfg, interpret=True),
+        mesh=mesh,
+        in_specs=(
+            gbdt.TrainState(forest=gbdt.Forest(P(), P(), P()), margin=P("dp"), round=P()),
+            P("dp", None, None), P("dp"),
+        ),
+        out_specs=gbdt.TrainState(
+            forest=gbdt.Forest(P(), P(), P()), margin=P("dp"), round=P()
+        ),
+        check_vma=False,
+    )
+
+    s_ref = gbdt.init_state(cfg, n)
+    s_f = gbdt.init_state(cfg, n)
+    for _ in range(cfg.n_trees):
+        s_ref = ref_fn(s_ref, xb, y)
+        s_f = fused_fn(s_f, xb3, y)
+    fr = jax.tree.map(np.asarray, s_ref.forest)
+    ff = jax.tree.map(np.asarray, s_f.forest)
+    np.testing.assert_array_equal(ff.feature, fr.feature)
+    np.testing.assert_array_equal(ff.threshold, fr.threshold)
+    np.testing.assert_allclose(ff.leaf, fr.leaf, rtol=1e-3, atol=1e-5)

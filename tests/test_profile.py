@@ -1,0 +1,33 @@
+"""Observability: per-collective stats accumulation and reporting."""
+
+import numpy as np
+
+import rabit_tpu as rt
+from rabit_tpu.profile import CollectiveStats
+
+
+def test_stats_accumulate_solo():
+    rt.reset_collective_stats()
+    rt.init()
+    rt.allreduce(np.arange(10, dtype=np.float32), rt.SUM)
+    rt.allreduce(np.arange(4, dtype=np.float32), rt.MAX)
+    rt.broadcast({"x": 1}, 0)
+    rt.finalize()
+    s = rt.collective_stats()
+    assert s.ops["allreduce"].calls == 2
+    assert s.ops["allreduce"].nbytes == 10 * 4 + 4 * 4
+    assert s.ops["broadcast"].calls == 1
+    rep = s.report()
+    assert "allreduce" in rep and "MiB" in rep
+
+
+def test_stats_report_empty():
+    assert "no collectives" in CollectiveStats().report()
+
+
+def test_timed_context():
+    s = CollectiveStats()
+    with s.timed("allgather", 128):
+        pass
+    assert s.ops["allgather"].calls == 1
+    assert s.ops["allgather"].max_seconds >= 0

@@ -1,0 +1,56 @@
+"""Recovery-latency benchmark (BASELINE.md target: "Recovery latency ...
+checkpoint-recover under induced preemption").
+
+Runs the self-verifying recovery workload (tests/workers/recover_worker.py,
+10k floats x 3 iterations — the reference's model_recover_10_10k scenario
+shape) under the local cluster twice per world size: clean, and with a mock
+death at (rank 1, version 1, seq 1).  The difference is the end-to-end cost
+of detecting the death, restarting the worker, re-bootstrapping the mesh,
+replaying lost results, and serving the checkpoint.
+
+Prints one JSON line per world size:
+  {"world": N, "clean_s": ..., "failure_s": ..., "recovery_overhead_s": ...}
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO))
+
+from rabit_tpu.tracker.launcher import LocalCluster  # noqa: E402
+
+WORKER = str(REPO / "tests" / "workers" / "recover_worker.py")
+
+
+def run_once(world: int, extra: list[str], timeout: float = 180.0) -> float:
+    cmd = [sys.executable, WORKER, "rabit_engine=mock", "ndata=10000",
+           "niter=3", *extra]
+    cluster = LocalCluster(world, max_restarts=5, quiet=True)
+    t0 = time.perf_counter()
+    rc = cluster.run(cmd, timeout=timeout)
+    dt = time.perf_counter() - t0
+    if rc != 0 or any(r != 0 for r in cluster.returncodes):
+        raise RuntimeError(f"cluster failed: rc={rc} {cluster.returncodes}")
+    return dt
+
+
+def main() -> None:
+    worlds = [int(w) for w in (sys.argv[1:] or ["4", "8"])]
+    for world in worlds:
+        clean = min(run_once(world, []) for _ in range(2))
+        failure = min(run_once(world, ["mock=1,1,1,0"]) for _ in range(2))
+        print(json.dumps({
+            "world": world,
+            "clean_s": round(clean, 3),
+            "failure_s": round(failure, 3),
+            "recovery_overhead_s": round(failure - clean, 3),
+        }), flush=True)
+
+
+if __name__ == "__main__":
+    main()
