@@ -7,10 +7,25 @@ Higgs-1M") on a Higgs-shaped synthetic dataset: 1M rows x 28 features,
 the same algorithm on the host CPU with numpy bincount histograms — the
 CPU hist-method reference the targets table names.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Driver contract: prints ONE JSON line on stdout
+    {"metric", "value", "unit", "vs_baseline"}
+and must survive a flaky TPU backend.  Round-1 failed this gate because
+the axon TPU backend can HANG (not raise) during init, so no in-process
+retry can help — the hung call holds jax's backend lock.  Round-2 design:
+
+  * the parent process NEVER imports jax.  The device benchmark runs in a
+    child process (``bench.py --device-worker``) under a hard timeout;
+  * if the TPU child hangs or dies, one retry, then a forced-CPU child on
+    a 8x smaller problem so a (labelled) JSON line always lands;
+  * the numpy baseline is measured in-parent on a 1/8 row subsample and
+    scaled (bincount is linear in rows) — full-size burned minutes;
+  * progress lines go to stderr, flushed, so partial runs are diagnosable.
 """
 
 import json
+import os
+import subprocess
+import sys
 import time
 
 import numpy as np
@@ -20,16 +35,23 @@ N_FEATURES = 28
 N_BINS = 256
 DEPTH = 6
 TPU_ROUNDS = 8
-CPU_ROUNDS = 2
 LAM = 1.0
 LR = 0.3
 
+T_START = time.time()
+TPU_CHILD_TIMEOUT = 90.0   # compile is ~20-40s; 8 rounds are ~1s
+CPU_CHILD_TIMEOUT = 90.0
 
-def make_data(seed=0):
+
+def log(msg):
+    print(f"[bench +{time.time() - T_START:5.1f}s] {msg}", file=sys.stderr, flush=True)
+
+
+def make_data(n_rows, seed=0):
     rng = np.random.RandomState(seed)
-    xb = rng.randint(0, N_BINS, size=(N_ROWS, N_FEATURES), dtype=np.int32)
+    xb = rng.randint(0, N_BINS, size=(n_rows, N_FEATURES), dtype=np.int32)
     logits = (xb[:, 0] > 128).astype(np.float32) + 0.01 * xb[:, 1]
-    y = (logits + rng.randn(N_ROWS) > 1.5).astype(np.float32)
+    y = (logits + rng.randn(n_rows) > 1.5).astype(np.float32)
     return xb, y
 
 
@@ -63,16 +85,34 @@ def cpu_round(xb, y, margin):
     return margin + leaf[node]
 
 
-def bench_cpu(xb, y):
-    margin = np.zeros(N_ROWS, np.float32)
+def bench_cpu_scaled(n_rows):
+    """Per-round numpy time at n_rows, measured on a 1/8 subsample.
+
+    cpu_round is dominated by the O(n*F) segment build + bincount, linear
+    in rows, so subsample-and-scale is a fair estimate and ~8x cheaper
+    than the full-size run that sank round 1's wall clock.
+    """
+    sub = max(n_rows // 8, 1)
+    xb, y = make_data(sub, seed=1)
+    margin = np.zeros(sub, np.float32)
+    margin = cpu_round(xb, y, margin)  # warm caches / allocators
     t0 = time.perf_counter()
-    for _ in range(CPU_ROUNDS):
-        margin = cpu_round(xb, y, margin)
-    return (time.perf_counter() - t0) / CPU_ROUNDS
+    margin = cpu_round(xb, y, margin)
+    per_round_sub = time.perf_counter() - t0
+    return per_round_sub * (n_rows / sub)
 
 
-def bench_tpu(xb, y):
+# --------------------------------------------------------------------------
+# Device-worker child: the only code path that touches jax.
+# --------------------------------------------------------------------------
+
+def device_worker(n_rows, n_rounds, force_cpu):
     import functools
+
+    if force_cpu:
+        from rabit_tpu._platform import force_cpu_platform
+
+        force_cpu_platform(1)
 
     import jax
     import jax.numpy as jnp
@@ -80,41 +120,118 @@ def bench_tpu(xb, y):
     from rabit_tpu.models import gbdt
     from rabit_tpu.ops import boost
 
+    devs = jax.devices()
+    plat = devs[0].platform
+    log(f"worker: backend up: {plat} x{len(devs)}")
+    xb, y = make_data(n_rows)
     cfg = gbdt.GBDTConfig(
-        n_features=N_FEATURES, n_trees=TPU_ROUNDS + 2, depth=DEPTH,
+        n_features=N_FEATURES, n_trees=n_rounds + 2, depth=DEPTH,
         n_bins=N_BINS, learning_rate=LR, reg_lambda=LAM,
     )
-    step = jax.jit(functools.partial(gbdt.train_round_fused, cfg=cfg), donate_argnums=0)
-    xb3, _ = boost.block_rows(jnp.asarray(xb))
+    # Fused Pallas kernels on TPU; pure-XLA train_round elsewhere (Pallas
+    # only interprets on CPU) — same dispatch as gbdt.GBDT.fit.
+    fused = jax.default_backend() == "tpu"
+    if fused:
+        step = jax.jit(functools.partial(gbdt.train_round_fused, cfg=cfg), donate_argnums=0)
+        xb3, _ = boost.block_rows(jnp.asarray(xb))
+    else:
+        step = jax.jit(functools.partial(gbdt.train_round, cfg=cfg), donate_argnums=0)
+        xb3 = jnp.asarray(xb)
     y_d = jnp.asarray(y)
-    state = gbdt.init_state(cfg, N_ROWS)
+    state = gbdt.init_state(cfg, n_rows)
+    log(f"worker: compiling {'train_round_fused' if fused else 'train_round'} ...")
     state = step(state, xb3, y_d)  # compile + warm
     # block_until_ready does not actually fence on the axon relay platform;
     # a host readback of a small output does.
     jax.device_get(state.forest.leaf)
+    log(f"worker: compiled; timing {n_rounds} rounds")
     t0 = time.perf_counter()
-    for _ in range(TPU_ROUNDS):
+    for _ in range(n_rounds):
         state = step(state, xb3, y_d)
     jax.device_get(state.forest.leaf)
-    return (time.perf_counter() - t0) / TPU_ROUNDS
+    dt = (time.perf_counter() - t0) / n_rounds
+    print(json.dumps({"device_time": dt, "platform": plat}), flush=True)
+
+
+def run_child(n_rows, n_rounds, force_cpu, timeout):
+    cmd = [sys.executable, os.path.abspath(__file__), "--device-worker",
+           str(n_rows), str(n_rounds), str(int(force_cpu))]
+    try:
+        r = subprocess.run(
+            cmd, timeout=timeout, capture_output=True, text=True,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+    except subprocess.TimeoutExpired as te:
+        for line in (te.stderr or b"").decode(errors="replace").splitlines():
+            print(line, file=sys.stderr, flush=True)
+        log(f"child timed out after {timeout:.0f}s (force_cpu={force_cpu})")
+        return "timeout"
+    for line in r.stderr.splitlines():
+        print(line, file=sys.stderr, flush=True)
+    if r.returncode != 0:
+        tail = (r.stderr or "").strip().splitlines()[-3:]
+        log(f"child rc={r.returncode}: {' | '.join(tail)}")
+        return None
+    for line in reversed(r.stdout.strip().splitlines()):
+        try:
+            return json.loads(line)
+        except json.JSONDecodeError:
+            continue
+    log("child produced no JSON")
+    return None
 
 
 def main():
-    xb, y = make_data()
-    cpu_time = bench_cpu(xb, y)
-    tpu_time = bench_tpu(xb, y)
-    rounds_per_sec = 1.0 / tpu_time
-    print(
-        json.dumps(
-            {
-                "metric": "gbdt_hist_rounds_per_sec_1M_rows",
-                "value": round(rounds_per_sec, 3),
-                "unit": "rounds/s",
-                "vs_baseline": round(cpu_time / tpu_time, 3),
-            }
-        )
-    )
+    log(f"dataset: {N_ROWS} rows x {N_FEATURES} feats, {N_BINS} bins, depth {DEPTH}")
+    res = run_child(N_ROWS, TPU_ROUNDS, force_cpu=False, timeout=TPU_CHILD_TIMEOUT)
+    if res is None:
+        # Fast failure (UNAVAILABLE etc.) is often transient: retry once.
+        # A hang ("timeout") persists — don't burn another full timeout on it.
+        log("retrying TPU child once")
+        res = run_child(N_ROWS, TPU_ROUNDS, force_cpu=False, timeout=TPU_CHILD_TIMEOUT)
+    n_rows = N_ROWS
+    if not isinstance(res, dict):
+        # Forced-CPU fallback: smaller problem so the jitted round fits the
+        # budget; the line is labelled with platform+rows.
+        n_rows = N_ROWS // 8
+        log(f"falling back to forced-CPU child at {n_rows} rows")
+        res = run_child(n_rows, 2, force_cpu=True, timeout=CPU_CHILD_TIMEOUT)
+    if not isinstance(res, dict):
+        # Last resort: numpy-only numbers, so the driver still gets a line.
+        log("device bench unavailable; reporting numpy-only baseline")
+        cpu_time = bench_cpu_scaled(N_ROWS)
+        print(json.dumps({
+            "metric": "gbdt_hist_rounds_per_sec_1M_rows",
+            "value": round(1.0 / cpu_time, 3),
+            "unit": "rounds/s",
+            "vs_baseline": 1.0,
+            "platform": "numpy-fallback",
+            "rows_measured": N_ROWS,
+            "wall_s": round(time.time() - T_START, 1),
+        }), flush=True)
+        return
+    device_time = res["device_time"]
+    log(f"device per-round: {device_time * 1e3:.1f} ms on {res['platform']}; measuring numpy baseline")
+    cpu_time = bench_cpu_scaled(n_rows)
+    log(f"numpy per-round (scaled to {n_rows} rows): {cpu_time * 1e3:.1f} ms")
+    # The metric is defined at 1M rows.  If the fallback measured a smaller
+    # problem, rescale to the 1M-row-equivalent rate (the round is linear in
+    # rows) instead of reporting an inflated small-problem rate under the
+    # 1M-row metric name.  vs_baseline is a same-size ratio: no rescale.
+    scale = N_ROWS / n_rows
+    print(json.dumps({
+        "metric": "gbdt_hist_rounds_per_sec_1M_rows",
+        "value": round(1.0 / (device_time * scale), 3),
+        "unit": "rounds/s",
+        "vs_baseline": round(cpu_time / device_time, 3),
+        "platform": res["platform"],
+        "rows_measured": n_rows,
+        "wall_s": round(time.time() - T_START, 1),
+    }), flush=True)
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) > 1 and sys.argv[1] == "--device-worker":
+        device_worker(int(sys.argv[2]), int(sys.argv[3]), bool(int(sys.argv[4])))
+    else:
+        main()

@@ -1,0 +1,60 @@
+"""JAX platform pinning for tests, dryruns, and benchmark fallbacks.
+
+The container's sitecustomize force-registers the experimental 'axon' TPU
+backend through jax config — ``JAX_PLATFORMS=cpu`` in the environment does
+NOT stick — so pinning to CPU requires overriding the config knob itself,
+and the virtual device count must land in ``XLA_FLAGS`` before the first
+backend/device query.  This is the single shared copy of that trick
+(tests/conftest.py, __graft_entry__.py and bench.py all use it; they had
+drifted as three hand-rolled variants in round 1).
+"""
+
+import os
+import re
+
+_COUNT_FLAG = "--xla_force_host_platform_device_count"
+
+
+def force_cpu_platform(n_devices: int = 1) -> None:
+    """Pin JAX to a virtual ``n_devices``-device CPU platform.
+
+    Must be called before anything initializes a JAX backend (first
+    ``jax.devices()``/``jit`` call); a pre-existing device-count flag is
+    replaced, not silently kept.  Calling too late raises RuntimeError
+    (unless the live backend already satisfies the request) instead of
+    silently no-opping into an axon-backend hang.
+    """
+    import jax
+
+    if _backends_initialized():
+        devs = jax.devices()
+        if devs[0].platform == "cpu" and len(devs) >= n_devices:
+            return  # idempotent: already pinned satisfactorily
+        raise RuntimeError(
+            "force_cpu_platform called after a JAX backend initialized "
+            f"({devs[0].platform} x{len(devs)}); pin before first device use "
+            "or run in a fresh process"
+        )
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    if _COUNT_FLAG in flags:
+        flags = re.sub(rf"{_COUNT_FLAG}=\d+", f"{_COUNT_FLAG}={n_devices}", flags)
+    else:
+        flags = (flags + f" {_COUNT_FLAG}={n_devices}").strip()
+    os.environ["XLA_FLAGS"] = flags
+
+    jax.config.update("jax_platforms", "cpu")
+
+
+def _backends_initialized() -> bool:
+    try:
+        from jax._src import xla_bridge
+
+        return xla_bridge.backends_are_initialized()
+    except Exception:
+        try:
+            from jax._src import xla_bridge
+
+            return bool(xla_bridge._backends)
+        except Exception:
+            return False

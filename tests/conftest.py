@@ -1,22 +1,13 @@
 """Test configuration.
 
-Sharding/mesh tests run on a virtual 8-device CPU platform.  The container's
-sitecustomize force-registers the TPU ('axon') backend via jax config — env
-vars alone don't stick — so we must override the config knob itself before
-the backend initializes, and XLA_FLAGS before first device query.
+Sharding/mesh tests run on a virtual 8-device CPU platform, pinned by the
+shared helper (see rabit_tpu/_platform.py for why env vars alone don't
+stick in this container).
 """
 
-import os
+from rabit_tpu._platform import force_cpu_platform
 
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
-
-import jax  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")
+force_cpu_platform(8)
 
 import pytest  # noqa: E402
 
