@@ -63,6 +63,14 @@ int RabitLoadCheckPoint(char** out_global, trt_ulong* out_global_len,
 int RabitCheckPoint(const char* global_data, trt_ulong global_len,
                     const char* local_data, trt_ulong local_len);
 int RabitLazyCheckPoint(const char* global_data, trt_ulong global_len);
+/* True lazy checkpoint: `serialize_fn` is invoked only if a failure needs
+ * the blob (reference global_lazycheck, allreduce_robust.cc:527-535).  It
+ * must return 0 and set (*out_data, *out_len) to bytes valid until it is
+ * next called; the engine copies before returning.  The callback (and the
+ * model it serializes) must stay valid until the next checkpoint call. */
+int TrtLazyCheckPointFn(int (*serialize_fn)(void* ctx, const char** out_data,
+                                            trt_ulong* out_len),
+                        void* ctx);
 int RabitVersionNumber(void);
 int RabitInitAfterException(void);
 

@@ -385,26 +385,33 @@ inline void CheckPoint(const Serializable* global_model,
                 "CheckPoint");
 }
 
-/// Checkpoint whose blob is only *copied* lazily: the engine keeps a
-/// pointer and serves the bytes to a recovering peer on demand.  The
-/// caller must keep `global_model` unchanged until the next checkpoint
-/// (reference LazyCheckPoint contract, rabit.h:311-332).  Note: crossing
-/// the C ABI, serialization itself is eager; what stays lazy is the
-/// engine-side copy.
-inline void LazyCheckPoint(const Serializable* global_model) {
+namespace detail {
+/// Serialize-on-demand adapter for TrtLazyCheckPointFn: Save() runs only
+/// when the engine actually needs the blob (a failure happened).  The
+/// thread_local keeps the produced bytes valid until the engine's copy
+/// completes (it copies before the invoking call returns).
+inline int SerializeOnDemand(void* ctx, const char** out, trt_ulong* len) {
   thread_local std::string blob;
-  std::string next;
-  MemoryBufferStream fs(&next);
-  global_model->Save(&fs);
-  // Swap into the thread-local BEFORE registering: the engine must get a
-  // pointer that outlives this call.  For short (SSO) strings swap copies
-  // between in-object buffers, so next.data() would dangle at return;
-  // blob.data() is stable until the next LazyCheckPoint.  The engine is
-  // single-threaded per the API contract, so it cannot dereference the
-  // previous pointer between the swap and the call below.
-  blob.swap(next);
-  detail::Check(RabitLazyCheckPoint(blob.data(), blob.size()),
-                "LazyCheckPoint");
+  blob.clear();
+  MemoryBufferStream fs(&blob);
+  static_cast<const Serializable*>(ctx)->Save(&fs);
+  *out = blob.data();
+  *len = blob.size();
+  return 0;
+}
+}  // namespace detail
+
+/// Checkpoint without serializing: the engine records a serialize callback
+/// and invokes it only if a failure actually needs the blob (reference
+/// LazyCheckPoint/global_lazycheck contract, rabit.h:311-332 +
+/// allreduce_robust.cc:527-535).  The caller must keep `global_model`
+/// alive and unchanged until the next checkpoint.
+inline void LazyCheckPoint(const Serializable* global_model) {
+  detail::Check(
+      TrtLazyCheckPointFn(&detail::SerializeOnDemand,
+                          const_cast<void*>(
+                              static_cast<const void*>(global_model))),
+      "LazyCheckPoint");
 }
 
 /// Checkpoint version = number of CheckPoint calls so far.

@@ -167,6 +167,11 @@ int RabitLazyCheckPoint(const char* global_data, trt_ulong global_len) {
   return Guard([&] { GetEngine()->LazyCheckPoint(global_data, global_len); });
 }
 
+int TrtLazyCheckPointFn(int (*serialize_fn)(void*, const char**, trt_ulong*),
+                        void* ctx) {
+  return Guard([&] { GetEngine()->LazyCheckPointFn(serialize_fn, ctx); });
+}
+
 int RabitVersionNumber() { return GetEngine()->VersionNumber(); }
 
 int RabitInitAfterException() {

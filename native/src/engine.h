@@ -29,6 +29,12 @@ ReduceFn BuiltinReducer(int op, int dtype);  // nullptr if unsupported
 
 using PrepareFn = void (*)(void* arg);
 
+// Serialize-on-demand callback for true lazy checkpoints: returns 0 and a
+// (data, len) view that must stay valid until the call that invoked it
+// returns (the engine copies immediately).  Non-zero = serialization failed.
+using SerializeFn = int (*)(void* ctx, const char** out_data,
+                            uint64_t* out_len);
+
 class Engine {
  public:
   virtual ~Engine() = default;
@@ -64,6 +70,16 @@ class Engine {
   // until the next checkpoint (reference LazyCheckPoint contract,
   // rabit.h:311-332).
   virtual void LazyCheckPoint(const char* gdata, size_t glen) = 0;
+  // True lazy checkpoint: serialization itself is deferred until a failure
+  // actually needs the blob (reference global_lazycheck,
+  // allreduce_robust.cc:527-535).  The callback must produce the same bytes
+  // until the next checkpoint; non-robust engines may invoke it eagerly.
+  virtual void LazyCheckPointFn(SerializeFn fn, void* ctx) {
+    const char* data = nullptr;
+    uint64_t len = 0;
+    TRT_CHECK(fn(ctx, &data, &len) == 0, "lazy checkpoint serializer failed");
+    LazyCheckPoint(data, len);
+  }
   virtual int VersionNumber() const = 0;
   virtual void InitAfterException() = 0;
 };

@@ -64,6 +64,38 @@ struct PeerState {
 };
 static_assert(sizeof(PeerState) == 16, "PeerState must be packed");
 
+// O(log W) healthy-path consensus summary (the reference's ActionSummary
+// role, allreduce_robust.h:224-322): one tree allreduce of these 40 bytes
+// decides whether anyone needs recovery.  Only when it shows divergence
+// does the O(world) PeerState table exchange below run — at 256 workers
+// that is ~16 serial hops per collective instead of ~255.
+struct Summary {
+  uint32_t or_mode;     // OR of per-rank mode bits
+  uint32_t and_mode;    // AND of per-rank mode bits
+  uint32_t or_loaded;   // OR of the loaded bit
+  uint32_t and_loaded;  // AND of the loaded bit
+  int32_t min_ver, max_ver;    // over non-loader ranks (neutral for loaders)
+  uint32_t min_seq, max_seq;   // over non-loader, non-ack ranks
+  int32_t nl_min, nl_max;      // over ranks whose nlocal is fixed (>= 0)
+};
+
+void ReduceSummary(void* dst, const void* src, size_t count, void*) {
+  auto* d = static_cast<Summary*>(dst);
+  auto* s = static_cast<const Summary*>(src);
+  for (size_t i = 0; i < count; ++i) {
+    d[i].or_mode |= s[i].or_mode;
+    d[i].and_mode &= s[i].and_mode;
+    d[i].or_loaded |= s[i].or_loaded;
+    d[i].and_loaded &= s[i].and_loaded;
+    d[i].min_ver = std::min(d[i].min_ver, s[i].min_ver);
+    d[i].max_ver = std::max(d[i].max_ver, s[i].max_ver);
+    d[i].min_seq = std::min(d[i].min_seq, s[i].min_seq);
+    d[i].max_seq = std::max(d[i].max_seq, s[i].max_seq);
+    d[i].nl_min = std::min(d[i].nl_min, s[i].nl_min);
+    d[i].nl_max = std::max(d[i].nl_max, s[i].nl_max);
+  }
+}
+
 // What the table tells every rank to do next.  Computed identically on all
 // ranks from identical tables, so the sub-collectives below stay aligned.
 enum class Act {
@@ -136,6 +168,9 @@ class RobustEngine : public Engine {
     timeout_sec_ = cfg.GetBool("rabit_timeout", false)
                        ? static_cast<double>(cfg.GetInt("rabit_timeout_sec", 1800))
                        : 0.0;
+    // rabit_consensus_summary=0 forces the full table exchange every round
+    // (testing / before-after measurement of the O(log W) fast path).
+    use_summary_ = cfg.GetBool("rabit_consensus_summary", true);
     result_round_ = std::max(comm_.world() / num_global_replica_, 1);
   }
 
@@ -245,6 +280,12 @@ class RobustEngine : public Engine {
     CheckPointImpl(gdata, glen, nullptr, 0, /*lazy=*/true);
   }
 
+  void LazyCheckPointFn(SerializeFn fn, void* ctx) override {
+    // True lazy: not even serialization happens unless a failure needs the
+    // blob (reference global_lazycheck, allreduce_robust.cc:527-535).
+    CheckPointImpl(nullptr, 0, nullptr, 0, /*lazy=*/true, fn, ctx);
+  }
+
   int VersionNumber() const override { return version_; }
 
   void InitAfterException() override {
@@ -308,6 +349,47 @@ class RobustEngine : public Engine {
       me.version = version_;
       me.seqno = seqno_;
       me.nlocal = num_local_replica_;
+      // Fast path: one O(log W) tree allreduce of the Summary.  All ranks
+      // compute the identical reduced value, so the decision to fall
+      // through to the full table exchange is globally consistent.
+      if (use_summary_) {
+        Summary s = LocalSummary(me);
+        if (comm_.AllreduceTree(reinterpret_cast<char*>(&s), sizeof(s), 1,
+                                ReduceSummary, nullptr) != IoResult::kOk) {
+          CheckAndRecover();
+          continue;
+        }
+        TRT_CHECK(s.nl_min == INT32_MAX || s.nl_min == s.nl_max,
+                  "ranks disagree on num_local_replica (%d vs %d)", s.nl_min,
+                  s.nl_max);
+        bool mixed_loaded = s.or_loaded != s.and_loaded;
+        bool uniform = s.min_ver == s.max_ver &&
+                       (s.min_seq == UINT32_MAX || s.min_seq == s.max_seq);
+        if (!mixed_loaded && uniform && s.or_mode == s.and_mode) {
+          if (s.or_mode == 0) {
+            // Healthy world running data ops: everyone executes live.
+            TRT_CHECK(mode == 0,
+                      "collective mismatch: rank %d is in a %s while peers "
+                      "run data ops",
+                      comm_.rank(),
+                      mode == kStInLoadCheck ? "LoadCheckPoint" : "CheckPoint");
+            watchdog_.Disarm();
+            return false;
+          }
+          if (s.or_mode == kStInCheckPoint) {
+            TRT_CHECK(mode == kStInCheckPoint, "consensus desync at checkpoint");
+            watchdog_.Disarm();
+            return true;  // all ranks at the phase-1 barrier: commit
+          }
+          if (s.or_mode == kStInCheckAck) {
+            TRT_CHECK(mode == kStInCheckAck, "consensus desync at ack");
+            watchdog_.Disarm();
+            return true;  // phase-2 barrier resolved
+          }
+          // All ranks in LoadCheckPoint (whole-world restart) still needs
+          // the table (owner election): fall through.
+        }
+      }
       std::vector<PeerState> table(comm_.world());
       if (comm_.Allgather(&me, sizeof(me), table.data()) != IoResult::kOk) {
         CheckAndRecover();
@@ -377,6 +459,25 @@ class RobustEngine : public Engine {
       }
       if (r != IoResult::kOk) CheckAndRecover();
     }
+  }
+
+  // My contribution to the tree-reduced Summary, with neutral elements for
+  // the fields my mode excludes (mirrors Decide()'s exclusion rules).
+  Summary LocalSummary(const PeerState& me) const {
+    uint32_t m = me.flags & kModeMask;
+    uint32_t loaded = (me.flags & kStLoaded) != 0 ? 1u : 0u;
+    bool is_loader = m == kStInLoadCheck;
+    bool is_ack = m == kStInCheckAck;
+    Summary s;
+    s.or_mode = s.and_mode = m;
+    s.or_loaded = s.and_loaded = loaded;
+    s.min_ver = is_loader ? INT32_MAX : me.version;
+    s.max_ver = is_loader ? INT32_MIN : me.version;
+    s.min_seq = (is_loader || is_ack) ? UINT32_MAX : me.seqno;
+    s.max_seq = (is_loader || is_ack) ? 0 : me.seqno;
+    s.nl_min = me.nlocal >= 0 ? me.nlocal : INT32_MAX;
+    s.nl_max = me.nlocal >= 0 ? me.nlocal : INT32_MIN;
+    return s;
   }
 
   Act Decide(const std::vector<PeerState>& table) const {
@@ -516,6 +617,7 @@ class RobustEngine : public Engine {
       version_ = static_cast<int>(hdr.version);
       global_ckpt_ = std::move(blob);
       has_lazy_ = false;
+      lazy_fn_ = nullptr;
       num_local_replica_ = hdr.nlocal;
       has_local_model_ = hdr.has_local;
     }
@@ -711,10 +813,11 @@ class RobustEngine : public Engine {
   // --- checkpoint ---------------------------------------------------------
 
   void CheckPointImpl(const char* gdata, size_t glen, const char* ldata,
-                      size_t llen, bool lazy) {
+                      size_t llen, bool lazy, SerializeFn fn = nullptr,
+                      void* fn_ctx = nullptr) {
     double t0 = NowSec();
     if (!comm_.distributed()) {
-      StoreGlobal(gdata, glen, lazy);
+      StoreGlobal(gdata, glen, lazy, fn, fn_ctx);
       if (ldata != nullptr) {
         local_ckpt_.assign(ldata, ldata + llen);
         local_ckpt_version_ = version_ + 1;
@@ -745,7 +848,7 @@ class RobustEngine : public Engine {
     // Commit: everything between the barriers is local, so every rank that
     // reaches a consensus round afterwards is observably pre- or
     // post-commit, never in between.
-    StoreGlobal(gdata, glen, lazy);
+    StoreGlobal(gdata, glen, lazy, fn, fn_ctx);
     if (has_local_model_ == 1) {
       local_ckpt_.assign(ldata, ldata + llen);
       local_ckpt_version_ = version_ + 1;
@@ -779,26 +882,39 @@ class RobustEngine : public Engine {
   // post-barrier / pre-commit window (see MockEngine, seqno spec -3).
   virtual void TestHookAfterBarrier() {}
 
-  void StoreGlobal(const char* gdata, size_t glen, bool lazy) {
+  void StoreGlobal(const char* gdata, size_t glen, bool lazy,
+                   SerializeFn fn = nullptr, void* fn_ctx = nullptr) {
     if (lazy) {
-      // Defer the copy until a failure actually needs the blob (reference
+      // Defer the copy — or, with a serializer callback, serialization
+      // itself — until a failure actually needs the blob (reference
       // LazyCheckPoint/global_lazycheck, rabit.h:311-332): caller keeps the
-      // buffer alive and unchanged until the next checkpoint.
+      // model alive and unchanged until the next checkpoint.
       lazy_ptr_ = gdata;
       lazy_len_ = glen;
+      lazy_fn_ = fn;
+      lazy_ctx_ = fn_ctx;
       has_lazy_ = true;
       global_ckpt_.clear();
     } else {
       global_ckpt_.assign(gdata, gdata + glen);
       has_lazy_ = false;
+      lazy_fn_ = nullptr;
     }
   }
 
   void MaterializeGlobal() {
-    if (has_lazy_) {
+    if (!has_lazy_) return;
+    if (lazy_fn_ != nullptr) {
+      const char* data = nullptr;
+      uint64_t len = 0;
+      TRT_CHECK(lazy_fn_(lazy_ctx_, &data, &len) == 0,
+                "lazy checkpoint serializer failed");
+      global_ckpt_.assign(data, data + len);
+    } else {
       global_ckpt_.assign(lazy_ptr_, lazy_ptr_ + lazy_len_);
-      has_lazy_ = false;
     }
+    has_lazy_ = false;
+    lazy_fn_ = nullptr;
   }
 
   // Chain my new local blob around the ring so my num_local_replica ring
@@ -836,6 +952,8 @@ class RobustEngine : public Engine {
   std::string global_ckpt_;
   const char* lazy_ptr_ = nullptr;
   size_t lazy_len_ = 0;
+  SerializeFn lazy_fn_ = nullptr;  // serialize-on-demand (wins over lazy_ptr_)
+  void* lazy_ctx_ = nullptr;
   bool has_lazy_ = false;
 
   // Replicated blobs are version-tagged: during a split checkpoint commit a
@@ -864,6 +982,7 @@ class RobustEngine : public Engine {
 
   bool debug_ = false;
   double timeout_sec_ = 0;
+  bool use_summary_ = true;
 };
 
 // Deterministic fault injection on top of the robust engine (reference:
@@ -942,6 +1061,12 @@ class MockEngine : public RobustEngine {
     VerifyAt(kSeqCheckPoint, "LazyCheckPoint");
     ReportCheckpointStats(glen);
     RobustEngine::LazyCheckPoint(gdata, glen);
+  }
+
+  void LazyCheckPointFn(SerializeFn fn, void* ctx) override {
+    VerifyAt(kSeqCheckPoint, "LazyCheckPoint");
+    ReportCheckpointStats(0);  // blob size unknown until serialized
+    RobustEngine::LazyCheckPointFn(fn, ctx);
   }
 
  protected:

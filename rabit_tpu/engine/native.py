@@ -29,6 +29,10 @@ _PREPARE_CB = ctypes.CFUNCTYPE(None, ctypes.c_void_p)
 _REDUCE_CB = ctypes.CFUNCTYPE(
     None, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint64, ctypes.c_void_p
 )
+_SERIALIZE_CB = ctypes.CFUNCTYPE(
+    ctypes.c_int, ctypes.c_void_p,
+    ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_uint64),
+)
 
 
 def _build_lib() -> None:
@@ -72,6 +76,7 @@ def load_lib() -> ctypes.CDLL:
             ctypes.c_char_p, ctypes.c_uint64, ctypes.c_char_p, ctypes.c_uint64
         ]
         lib.RabitLazyCheckPoint.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+        lib.TrtLazyCheckPointFn.argtypes = [_SERIALIZE_CB, ctypes.c_void_p]
         lib.RabitLoadCheckPoint.argtypes = [
             ctypes.POINTER(ctypes.POINTER(ctypes.c_char)),
             ctypes.POINTER(ctypes.c_uint64),
@@ -265,17 +270,30 @@ class NativeEngine(Engine):
         )
 
     def lazy_checkpoint(self, get_global_blob: Callable[[], bytes]) -> None:
-        # The ABI lazy path stores a pointer without copying; from Python we
-        # must keep the serialized bytes alive ourselves.  The PREVIOUS blob
-        # must stay alive through this call too: the engine may still serve
-        # it to a recovering peer during the new checkpoint's pre-commit
-        # consensus, so only drop it after the engine has switched over.
-        new_blob = get_global_blob()
-        self._check(
-            self._lib.RabitLazyCheckPoint(new_blob, len(new_blob)),
-            "lazy_checkpoint",
-        )
-        self._lazy_blob = new_blob
+        # True lazy across the ABI (reference global_lazycheck,
+        # allreduce_robust.cc:527-535): register a serialize-on-demand
+        # callback, so pickling only happens if a failure actually needs the
+        # blob.  Caller contract (same as the reference's, rabit.h:311-332):
+        # the model behind get_global_blob must stay unchanged until the
+        # next checkpoint — the callback can fire any time in that window,
+        # including while the NEXT checkpoint's pre-commit consensus still
+        # serves this version to a recovering peer.
+        def _serialize(ctx, out_data, out_len):
+            try:
+                self._lazy_blob = get_global_blob()
+                out_data[0] = self._lazy_blob
+                out_len[0] = len(self._lazy_blob)
+                return 0
+            except Exception:
+                return -1
+
+        cb = _SERIALIZE_CB(_serialize)
+        # Every callback the engine might still reference must stay alive:
+        # the previous one until this registration has definitely replaced
+        # it inside the engine — and both if the call fails partway.
+        self._lazy_keepalive = getattr(self, "_lazy_keepalive", []) + [cb]
+        self._check(self._lib.TrtLazyCheckPointFn(cb, None), "lazy_checkpoint")
+        self._lazy_keepalive = [cb]
 
     def version_number(self):
         return self._lib.RabitVersionNumber()

@@ -2,22 +2,32 @@
 
 The engine-level (host numpy) API for multi-host TPU jobs launched with
 ``jax.distributed``: rank = process index, world = process count, and the
-collectives ride XLA's DCN/ICI transport via ``jax.experimental.
-multihost_utils`` instead of the reference's hand-rolled TCP loops.  This is
-the third backend the reference's engine seam anticipated (engine_mpi.cc as
-the proof the seam is swappable; BASELINE.json north star).
+collectives ride XLA's DCN/ICI transport instead of the reference's
+hand-rolled TCP loops.  This is the third backend the reference's engine
+seam anticipated (engine_mpi.cc:20-101 as the proof the seam is swappable;
+BASELINE.json north star).
 
-In-graph device collectives live in ``rabit_tpu.parallel``; this engine is
-the host-side control surface with the same semantics as the others.
+The reduction itself runs ON DEVICE: each process contributes its array as
+one shard of a global array laid out over a one-device-per-process mesh, and
+a jitted reduction over the sharded axis with a replicated out-sharding
+makes XLA emit the cross-host AllReduce (O(log W) / ring, XLA's choice) —
+no allgather-then-host-fold.  Jit caching specializes per (shape, dtype)
+automatically; one compiled executable per (op, shape, dtype) is reused for
+the life of the process.
+
+In-graph device collectives for SPMD programs live in ``rabit_tpu.parallel``;
+this engine is the host-side control surface with the same semantics as the
+other backends.
 """
 
 from __future__ import annotations
 
+import os
 from typing import Callable
 
 import numpy as np
 
-from rabit_tpu.engine.base import Engine, numpy_reduce
+from rabit_tpu.engine.base import BITOR, MAX, MIN, SUM, Engine, numpy_reduce
 
 
 class XlaEngine(Engine):
@@ -27,12 +37,46 @@ class XlaEngine(Engine):
         self._global_blob: bytes | None = None
         self._local_blob: bytes | None = None
         self._lazy_thunk: Callable[[], bytes] | None = None
+        self._mesh = None
+        self._jits: dict[int, Callable] = {}
 
     def init(self) -> None:
         import jax
 
+        # Multi-process bootstrap: honour the standard JAX cluster env vars
+        # (as exported by tests/test_xla_engine.py or a real multi-host
+        # launcher).  Config keys override env so a launcher can pass them
+        # as argv k=v pairs.  Must run before any other jax call touches
+        # the backend.
+        coord = self.config.get(
+            "rabit_xla_coordinator", os.environ.get("JAX_COORDINATOR_ADDRESS", "")
+        )
+        nproc = int(
+            self.config.get(
+                "rabit_xla_num_processes", os.environ.get("JAX_NUM_PROCESSES", "0") or "0"
+            )
+        )
+        pid = self.config.get(
+            "rabit_xla_process_id", os.environ.get("JAX_PROCESS_ID", "")
+        )
+        if coord and nproc > 1 and pid != "":
+            try:
+                jax.distributed.initialize(coord, nproc, int(pid))
+            except RuntimeError as exc:
+                # Only double-initialization (the application bootstrapped
+                # jax.distributed itself) is benign — jax 0.9 phrases it
+                # "distributed.initialize should only be called once."; a
+                # dead coordinator or world mismatch must fail loudly, not
+                # degrade to world 1.
+                msg = str(exc).lower()
+                if "only be called once" not in msg and "already initialized" not in msg:
+                    raise
         self._rank = jax.process_index()
         self._world = jax.process_count()
+
+    def shutdown(self) -> None:
+        self._mesh = None
+        self._jits.clear()
 
     def get_rank(self) -> int:
         return getattr(self, "_rank", 0)
@@ -40,18 +84,96 @@ class XlaEngine(Engine):
     def get_world_size(self) -> int:
         return getattr(self, "_world", 1)
 
+    # -- device-side reduction --------------------------------------------
+
+    def _proc_mesh(self):
+        """A 1-D mesh with exactly one device per process, ordered by
+        process index — the engine's 'one shard per worker' data layout."""
+        if self._mesh is None:
+            import jax
+            from jax.sharding import Mesh
+
+            per_proc: dict[int, object] = {}
+            for d in jax.devices():
+                if d.process_index not in per_proc or d.id < per_proc[d.process_index].id:
+                    per_proc[d.process_index] = d
+            devs = [per_proc[p] for p in sorted(per_proc)]
+            if len(devs) != self._world:
+                raise RuntimeError(
+                    f"expected one device per process, got {len(devs)} for "
+                    f"world {self._world}"
+                )
+            self._mesh = Mesh(np.array(devs), ("p",))
+        return self._mesh
+
+    def _reduce_fn(self, op: int):
+        """Jitted reduce-over-shard-axis with replicated output: XLA lowers
+        this to one cross-process AllReduce on the device interconnect."""
+        if op not in self._jits:
+            import jax
+            import jax.numpy as jnp
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            mesh = self._proc_mesh()
+
+            if op == SUM:
+                red = lambda x: jnp.sum(x, axis=0)
+            elif op == MAX:
+                red = lambda x: jnp.max(x, axis=0)
+            elif op == MIN:
+                red = lambda x: jnp.min(x, axis=0)
+            elif op == BITOR:
+                # Cross-process reduce computations are restricted to
+                # sum/min/max on some backends (CPU Gloo rejects reduce-or),
+                # so OR is lowered to per-bit-plane MAX: expand to bits,
+                # max across processes, recombine (disjoint planes sum back
+                # exactly) — same trick as parallel/collectives.py's BITOR.
+                def red(x):
+                    dt = x.dtype
+                    nbits = dt.itemsize * 8
+                    wide = jnp.uint64 if nbits > 32 else jnp.uint32
+                    xu = x.astype(wide)
+                    if nbits < 64:
+                        xu = xu & np.array((1 << nbits) - 1, wide)
+                    shifts = jnp.arange(nbits, dtype=wide)
+                    bits = (xu[..., None] >> shifts) & np.array(1, wide)
+                    planes = jnp.max(bits, axis=0)
+                    return jnp.sum(planes << shifts, axis=-1, dtype=wide).astype(dt)
+            else:
+                raise ValueError(f"unknown reduction op {op}")
+            self._jits[op] = jax.jit(
+                red, out_shardings=NamedSharding(mesh, P())
+            )
+        return self._jits[op]
+
     def allreduce(self, data, op, prepare_fun=None, cache_key=None):
         if prepare_fun is not None:
             prepare_fun(data)
         if self.get_world_size() == 1:
             return data
-        from jax.experimental import multihost_utils as mhu
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
 
-        gathered = np.asarray(mhu.process_allgather(np.asarray(data)))
-        acc = np.array(gathered[0], copy=True)
-        for i in range(1, gathered.shape[0]):
-            acc = numpy_reduce(op, acc, gathered[i])
-        return acc.astype(data.dtype)
+        arr = np.ascontiguousarray(data)
+        if arr.dtype.itemsize == 8:
+            # Under JAX's default 32-bit mode device_put canonicalizes
+            # int64/float64 down to 32 bits — silent truncation.  64-bit
+            # payloads take a bit-exact host path instead: ship the raw
+            # bytes (uint8 survives canonicalization) and fold on host.
+            gathered = self.allgather(arr.view(np.uint8).reshape(-1))
+            parts = gathered.reshape(self._world, -1).view(arr.dtype)
+            acc = np.array(parts[0], copy=True)
+            for i in range(1, self._world):
+                acc = numpy_reduce(op, acc, parts[i])
+            return acc.reshape(arr.shape)
+        mesh = self._proc_mesh()
+        sharding = NamedSharding(mesh, P("p", *([None] * arr.ndim)))
+        local = jax.device_put(arr[None], mesh.devices[self._rank])
+        garr = jax.make_array_from_single_device_arrays(
+            (self._world,) + arr.shape, sharding, [local]
+        )
+        out = self._reduce_fn(op)(garr)
+        return np.asarray(out.addressable_data(0)).astype(arr.dtype)
 
     def broadcast(self, data, root, cache_key=None):
         if self.get_world_size() == 1:

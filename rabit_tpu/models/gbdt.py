@@ -270,6 +270,13 @@ def train_round_fused(
     g, h = gradients(cfg, state.margin, y)
     g3, _ = boost.block_rows(g, block)
     h3, _ = boost.block_rows(h, block)
+    if g3.shape[0] != xb3.shape[0]:
+        raise ValueError(
+            f"train_round_fused: {n} rows block into {g3.shape[0]} blocks of "
+            f"{block}, but xb3 has {xb3.shape[0]} blocks — a dp shard's row "
+            "count must match its pre-blocked feature matrix or rows would be "
+            "silently mispaired with gradients"
+        )
 
     hist = combine(boost.hist_level0(xb3, g3, h3, n_bins=cfg.n_bins,
                                      interpret=interpret))

@@ -50,7 +50,10 @@ def run_cluster(num_workers, worker_args=(), max_restarts=0, timeout=90,
     env.update(extra_env or {})
     cluster = LocalCluster(num_workers, max_restarts=max_restarts, quiet=True,
                            extra_env=env)
-    cmd = [sys.executable, str(WORKER), *map(str, worker_args)]
+    args = list(map(str, worker_args))
+    if not any(a.startswith("rabit_engine=") for a in args):
+        args.append("rabit_engine=base")
+    cmd = [sys.executable, str(WORKER), *args]
     rc = cluster.run(cmd, timeout=timeout)
     assert rc == 0
     return cluster

@@ -20,10 +20,14 @@ def check(cond, msg):
 
 
 def main():
-    rt.init(rabit_engine="base")
+    # Engine comes from argv k=v pairs (rabit_engine=base|xla|...), so the
+    # same self-verifying matrix proves every backend satisfies the seam —
+    # the reference's point with its MPI build of the tests (engine_mpi.cc).
+    rt.init()
     rank = rt.get_rank()
     world = rt.get_world_size()
-    n = int(sys.argv[1]) if len(sys.argv) > 1 else 1000
+    positional = [a for a in sys.argv[1:] if "=" not in a]
+    n = int(positional[0]) if positional else 1000
 
     # allreduce MAX: worker r contributes i + r -> expect i + world - 1
     x = np.arange(n, dtype=np.float32) + rank
@@ -40,6 +44,9 @@ def main():
     # allreduce MIN + BITOR
     out = rt.allreduce(np.array([rank + 5], dtype=np.int32), rt.MIN)
     check(out[0] == 5, "allreduce min")
+    # 64-bit payload beyond 32-bit range (catches silent downcasts)
+    out = rt.allreduce(np.array([(1 << 40) + rank], dtype=np.int64), rt.MAX)
+    check(out[0] == (1 << 40) + world - 1, "allreduce int64 max")
     out = rt.allreduce(np.array([1 << rank], dtype=np.uint32), rt.BITOR)
     check(out[0] == (1 << world) - 1, "allreduce bitor")
 
@@ -65,6 +72,16 @@ def main():
     out = rt.allreduce(np.zeros(4, np.float32), rt.SUM, prepare_fun=prep)
     check(called == [1], "prepare_fun called once")
     check(np.allclose(out, world * (world - 1) / 2), "prepare_fun allreduce")
+
+    # checkpoint / load_checkpoint roundtrip (every backend must version and
+    # return committed state, even those without cross-process recovery)
+    v0, m0 = rt.load_checkpoint()
+    check(v0 == 0 and m0 is None, "fresh load_checkpoint")
+    rt.checkpoint({"iter": 1, "rank_sum": float(out[0])})
+    check(rt.version_number() == 1, "version after checkpoint")
+    v1, m1 = rt.load_checkpoint()
+    check(v1 == 1 and m1 == {"iter": 1, "rank_sum": float(out[0])},
+          "load_checkpoint returns committed model")
 
     rt.tracker_print(f"worker {rank}/{world} ok\n")
     rt.finalize()

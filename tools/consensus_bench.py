@@ -1,0 +1,99 @@
+"""Healthy-path consensus cost: O(log W) summary vs O(W) table exchange.
+
+Every robust collective opens with a consensus round.  Round-2's protocol
+ring-allgathered the full PeerState table (world-1 serial hops per op);
+round 3 added a tree-allreduced 40-byte Summary fast path (reference
+ActionSummary analogue, allreduce_robust.h:224-322) with the table exchange
+only on divergence.  This tool measures tiny-payload robust allreduce
+latency with the fast path on (rabit_consensus_summary=1, default) and
+forced off (=0) at a given world size.
+
+Usage:  python tools/consensus_bench.py [--world 32] [--iters 200]
+Prints one JSON line per mode; run as __main__ only (spawns a local
+cluster).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+WORKER_SRC = """
+import sys, time
+import numpy as np
+import rabit_tpu as rt
+
+iters = int(sys.argv[1])
+out_path = sys.argv[2]
+rt.init()
+rank = rt.get_rank()
+x = np.zeros(4, np.float32)
+rt.allreduce(x, rt.SUM)  # warm links
+t0 = time.perf_counter()
+for _ in range(iters):
+    rt.allreduce(x, rt.SUM)
+dt = time.perf_counter() - t0
+if rank == 0:
+    with open(out_path, "w") as f:
+        f.write(str(dt / iters))
+rt.finalize()
+"""
+
+
+def run_mode(world: int, iters: int, summary_on: bool) -> float:
+    from rabit_tpu.tracker.launcher import LocalCluster
+
+    with tempfile.TemporaryDirectory() as td:
+        worker = Path(td) / "worker.py"
+        worker.write_text(WORKER_SRC)
+        out = Path(td) / "t.txt"
+        env = {"PYTHONPATH": f"{REPO}:{os.environ.get('PYTHONPATH', '')}"}
+        cluster = LocalCluster(world, quiet=True, extra_env=env)
+        cmd = [
+            sys.executable, str(worker), str(iters), str(out),
+            "rabit_engine=native",
+            f"rabit_consensus_summary={int(summary_on)}",
+        ]
+        rc = cluster.run(cmd, timeout=600.0)
+        assert rc == 0, f"cluster failed rc={rc}"
+        return float(out.read_text())
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--world", type=int, default=32)
+    ap.add_argument("--iters", type=int, default=200)
+    args = ap.parse_args()
+    results = {}
+    for on in (True, False):
+        per_op = run_mode(args.world, args.iters, on)
+        mode = "summary_ologw" if on else "table_ow"
+        results[mode] = per_op
+        print(json.dumps({
+            "bench": "consensus_healthy_path",
+            "mode": mode,
+            "world": args.world,
+            "iters": args.iters,
+            "per_op_ms": round(per_op * 1e3, 3),
+        }), flush=True)
+    print(json.dumps({
+        "bench": "consensus_healthy_path",
+        "world": args.world,
+        "speedup_summary_vs_table": round(
+            results["table_ow"] / results["summary_ologw"], 2
+        ),
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()
