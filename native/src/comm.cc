@@ -59,7 +59,19 @@ void Comm::Configure(const Config& cfg) {
   }
   ring_mincount_ = cfg.GetSize("rabit_reduce_ring_mincount", 32 << 10);
   tree_minsize_ = cfg.GetSize("rabit_tree_reduce_minsize", 1 << 20);
+  reduce_buffer_ = std::max<size_t>(cfg.GetSize("rabit_reduce_buffer", 256u << 20), 64);
   tcp_no_delay_ = cfg.GetBool("rabit_enable_tcp_no_delay", false);
+  // Hung-peer stall bound.  Engine-dependent default (default_stall_sec_,
+  // set before Configure): the robust engine turns a false positive into a
+  // recoverable re-bootstrap, so it defaults on; the base engine would die
+  // on one, so it defaults off unless explicitly configured.
+  int64_t stall_sec = cfg.Get("rabit_stall_timeout_sec", "").empty()
+                          ? default_stall_sec_
+                          : cfg.GetInt("rabit_stall_timeout_sec", 300);
+  int64_t ms = stall_sec * 1000;
+  stall_ms_ = stall_sec > 0
+                  ? static_cast<int>(std::min<int64_t>(ms, INT32_MAX))
+                  : -1;
   char buf[256];
   gethostname(buf, sizeof(buf));
   host_name_ = buf;
@@ -233,11 +245,14 @@ IoResult Comm::Allreduce(void* buf, size_t elem_size, size_t count,
 IoResult Comm::AllreduceTree(char* buf, size_t elem_size, size_t count,
                              ReduceFn fn, void* ctx) {
   const size_t total = elem_size * count;
-  // Pipeline in chunks of whole elements (reference tree_reduce_minsize).
-  size_t chunk = std::max(tree_minsize_ / elem_size, size_t(1)) * elem_size;
-  chunk = std::min(chunk, total);
   std::vector<TcpSocket*> kids;
   for (int c : children_) kids.push_back(LinkTo(c));
+  // Pipeline in chunks of whole elements (reference tree_reduce_minsize),
+  // capped so all per-child staging fits the rabit_reduce_buffer budget.
+  size_t budget = std::max(reduce_buffer_ / (kids.size() + 1), elem_size);
+  size_t chunk =
+      std::max(std::min(tree_minsize_, budget) / elem_size, size_t(1)) * elem_size;
+  chunk = std::min(chunk, total);
   TcpSocket* up = parent_ >= 0 ? LinkTo(parent_) : nullptr;
   std::vector<std::vector<char>> childbuf(kids.size(),
                                           std::vector<char>(chunk));
@@ -249,7 +264,7 @@ IoResult Comm::AllreduceTree(char* buf, size_t elem_size, size_t count,
       ts.push_back({kids[i]->fd(), childbuf[i].data(), n, 0, false});
     }
     if (!ts.empty() &&
-        DriveTransfers(ts.data(), static_cast<int>(ts.size())) != IoResult::kOk) {
+        DriveTransfers(ts.data(), static_cast<int>(ts.size()), stall_ms_) != IoResult::kOk) {
       return IoResult::kPeerFailure;
     }
     for (size_t i = 0; i < kids.size(); ++i) {
@@ -257,7 +272,7 @@ IoResult Comm::AllreduceTree(char* buf, size_t elem_size, size_t count,
     }
     if (up != nullptr) {
       Transfer t{up->fd(), buf + off, n, 0, true};
-      if (DriveTransfers(&t, 1) != IoResult::kOk) return IoResult::kPeerFailure;
+      if (DriveTransfers(&t, 1, stall_ms_) != IoResult::kOk) return IoResult::kPeerFailure;
     }
   }
   // Down-sweep: receive final chunks from parent, fan to children.
@@ -265,14 +280,14 @@ IoResult Comm::AllreduceTree(char* buf, size_t elem_size, size_t count,
     size_t n = std::min(chunk, total - off);
     if (up != nullptr) {
       Transfer t{up->fd(), buf + off, n, 0, false};
-      if (DriveTransfers(&t, 1) != IoResult::kOk) return IoResult::kPeerFailure;
+      if (DriveTransfers(&t, 1, stall_ms_) != IoResult::kOk) return IoResult::kPeerFailure;
     }
     std::vector<Transfer> ts;
     for (TcpSocket* kid : kids) {
       ts.push_back({kid->fd(), buf + off, n, 0, true});
     }
     if (!ts.empty() &&
-        DriveTransfers(ts.data(), static_cast<int>(ts.size())) != IoResult::kOk) {
+        DriveTransfers(ts.data(), static_cast<int>(ts.size()), stall_ms_) != IoResult::kOk) {
       return IoResult::kPeerFailure;
     }
   }
@@ -292,17 +307,39 @@ IoResult Comm::AllreduceRing(char* buf, size_t elem_size, size_t count,
   };
   size_t maxchunk = 0;
   for (int c = 0; c < n; ++c) maxchunk = std::max(maxchunk, chunk_size(c));
-  std::vector<char> tmp(maxchunk);
+  // Scratch is the only staging this path allocates; honor the
+  // rabit_reduce_buffer budget by sub-chunking each ring step (send piece k
+  // and recv piece k are driven full-duplex, so neighbors progress in
+  // lockstep exactly as with whole chunks).
+  size_t piece =
+      std::max(std::min(maxchunk, reduce_buffer_ / 2) / elem_size, size_t(1)) *
+      elem_size;
+  std::vector<char> tmp(std::min(maxchunk, piece));
   // Reduce-scatter: step s sends chunk (rank-s), receives+folds (rank-s-1).
   for (int s = 0; s < n - 1; ++s) {
     int sc = ((rank_ - s) % n + n) % n;
     int rc = ((rank_ - s - 1) % n + n) % n;
-    Transfer ts[2] = {
-        {next->fd(), buf + chunk_begin(sc), chunk_size(sc), 0, true},
-        {prev->fd(), tmp.data(), chunk_size(rc), 0, false},
-    };
-    if (DriveTransfers(ts, 2) != IoResult::kOk) return IoResult::kPeerFailure;
-    fn(buf + chunk_begin(rc), tmp.data(), chunk_size(rc) / elem_size, ctx);
+    size_t stotal = chunk_size(sc), rtotal = chunk_size(rc);
+    size_t soff = 0, roff = 0;
+    while (soff < stotal || roff < rtotal) {
+      size_t sn = std::min(piece, stotal - soff);
+      size_t rn = std::min(piece, rtotal - roff);
+      Transfer ts[2] = {
+          {next->fd(), buf + chunk_begin(sc) + soff, sn, 0, true},
+          {prev->fd(), tmp.data(), rn, 0, false},
+      };
+      int nt = 2;
+      if (rn == 0) nt = 1;
+      if (sn == 0) { ts[0] = ts[1]; nt = 1; }
+      if (DriveTransfers(ts, nt, stall_ms_) != IoResult::kOk) {
+        return IoResult::kPeerFailure;
+      }
+      if (rn > 0) {
+        fn(buf + chunk_begin(rc) + roff, tmp.data(), rn / elem_size, ctx);
+      }
+      soff += sn;
+      roff += rn;
+    }
   }
   // Allgather: rank owns chunk (rank+1); circulate owned chunks.
   for (int s = 0; s < n - 1; ++s) {
@@ -312,7 +349,7 @@ IoResult Comm::AllreduceRing(char* buf, size_t elem_size, size_t count,
         {next->fd(), buf + chunk_begin(sc), chunk_size(sc), 0, true},
         {prev->fd(), buf + chunk_begin(rc), chunk_size(rc), 0, false},
     };
-    if (DriveTransfers(ts, 2) != IoResult::kOk) return IoResult::kPeerFailure;
+    if (DriveTransfers(ts, 2, stall_ms_) != IoResult::kOk) return IoResult::kPeerFailure;
   }
   return IoResult::kOk;
 }
@@ -345,12 +382,12 @@ IoResult Comm::Broadcast(void* data, size_t size, int root) {
     size_t nb = std::min(chunk, size - off);
     if (in_link >= 0) {
       Transfer t{LinkTo(in_link)->fd(), buf + off, nb, 0, false};
-      if (DriveTransfers(&t, 1) != IoResult::kOk) return IoResult::kPeerFailure;
+      if (DriveTransfers(&t, 1, stall_ms_) != IoResult::kOk) return IoResult::kPeerFailure;
     }
     std::vector<Transfer> ts;
     for (TcpSocket* o : out) ts.push_back({o->fd(), buf + off, nb, 0, true});
     if (!ts.empty() &&
-        DriveTransfers(ts.data(), static_cast<int>(ts.size())) != IoResult::kOk) {
+        DriveTransfers(ts.data(), static_cast<int>(ts.size()), stall_ms_) != IoResult::kOk) {
       return IoResult::kPeerFailure;
     }
   }
@@ -369,7 +406,7 @@ IoResult Comm::RingExchange(const void* send, size_t send_bytes, void* recv,
        send_bytes, 0, true},
       {LinkTo(ring_prev_)->fd(), static_cast<char*>(recv), recv_bytes, 0, false},
   };
-  return DriveTransfers(ts, 2);
+  return DriveTransfers(ts, 2, stall_ms_);
 }
 
 IoResult Comm::Allgather(const void* mine, size_t slice_bytes, void* out) {

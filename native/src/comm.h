@@ -36,6 +36,10 @@ using ReduceFn = void (*)(void* dst, const void* src, size_t count, void* ctx);
 
 class Comm {
  public:
+  // Engine-dependent default for rabit_stall_timeout_sec; call BEFORE
+  // Configure (0 = wait forever; see stall_ms_).
+  void SetDefaultStallSec(int sec) { default_stall_sec_ = sec; }
+
   void Configure(const Config& cfg);
 
   // Bootstrap against the tracker ("start") or re-bootstrap after a failure
@@ -102,6 +106,20 @@ class Comm {
   std::map<int, TcpSocket> links_;
   size_t ring_mincount_ = 32 << 10;   // rabit_reduce_ring_mincount
   size_t tree_minsize_ = 1 << 20;     // rabit_tree_reduce_minsize (chunk)
+  // Memory budget for collective staging buffers (rabit_reduce_buffer,
+  // reference allreduce_base.cc:37 + ring-buffer flow control
+  // allreduce_base.h:298-398): bounds tree child buffers and the ring
+  // scratch chunk, NOT caller-owned result buffers.
+  size_t reduce_buffer_ = 256u << 20;
+  // Hung-peer liveness bound: a transfer making zero progress for this long
+  // is treated as a peer failure (rabit_stall_timeout_sec; 0 = wait
+  // forever).  The default is generous so ordinary compute skew between
+  // workers does not trip it — but extreme skew (>5 min between
+  // collectives) can, which on the robust engine costs one spurious
+  // recovery round and on the base engine is fatal; hence the base engine
+  // defaults it off (SetDefaultStallSec).
+  int default_stall_sec_ = 300;
+  int stall_ms_ = 300000;
   bool tcp_no_delay_ = false;
   bool initialized_ = false;
 };

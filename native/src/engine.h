@@ -141,6 +141,10 @@ class EmptyEngine : public Engine {
 class BaseEngine : public Engine {
  public:
   void Init(const Config& cfg) override {
+    // No fault tolerance here: a stall false-positive would be fatal, so
+    // the liveness bound is off unless explicitly configured (the robust
+    // engine keeps the on-by-default bound and recovers from one).
+    comm_.SetDefaultStallSec(0);
     comm_.Configure(cfg);
     comm_.Init(/*recover=*/false);
   }

@@ -165,7 +165,11 @@ class RobustEngine : public Engine {
         std::max<int>(0, static_cast<int>(cfg.GetInt("rabit_local_replica", 2)));
     boot_cache_on_ = cfg.GetBool("rabit_bootstrap_cache", false);
     debug_ = cfg.GetBool("rabit_debug", false);
-    timeout_sec_ = cfg.GetBool("rabit_timeout", false)
+    // Armed by DEFAULT during recovery (round-3 change; the reference left
+    // this opt-in, allreduce_base.h:581): a worker blocked in recovery for
+    // a dead-and-never-restarted or wedged peer must eventually abort so
+    // the launcher can make forward progress.  rabit_timeout=0 disables.
+    timeout_sec_ = cfg.GetBool("rabit_timeout", true)
                        ? static_cast<double>(cfg.GetInt("rabit_timeout_sec", 1800))
                        : 0.0;
     // rabit_consensus_summary=0 forces the full table exchange every round

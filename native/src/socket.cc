@@ -28,7 +28,14 @@ IoResult DriveTransfers(Transfer* transfers, int n, int timeout_ms) {
       if (errno == EINTR) continue;
       throw Error(Format("poll failed: %s", strerror(errno)));
     }
-    if (rc == 0) throw Error("poll timeout on link transfer");
+    if (rc == 0) {
+      // No fd became ready for the whole window: a wedged (e.g. SIGSTOPped)
+      // peer looks exactly like this — socket open, nothing flowing.  Treat
+      // it as a peer failure so the robust layer can recover instead of
+      // hanging forever (the reference's OOB CheckExcept machinery exists
+      // for the same reason, socket.h:440-533).
+      return IoResult::kPeerFailure;
+    }
     for (int i = 0; i < n; ++i) {
       Transfer& t = transfers[i];
       if (t.Finished()) continue;

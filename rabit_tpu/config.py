@@ -54,8 +54,9 @@ DEFAULTS: dict[str, str] = {
     "rabit_reduce_buffer": "256M",
     "rabit_global_replica": "5",
     "rabit_local_replica": "2",
-    "rabit_timeout": "0",
+    "rabit_timeout": "1",
     "rabit_timeout_sec": "1800",
+    "rabit_stall_timeout_sec": "300",
     "rabit_bootstrap_cache": "0",
     "rabit_debug": "0",
     "rabit_enable_tcp_no_delay": "0",

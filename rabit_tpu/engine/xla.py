@@ -59,7 +59,19 @@ class XlaEngine(Engine):
         pid = self.config.get(
             "rabit_xla_process_id", os.environ.get("JAX_PROCESS_ID", "")
         )
-        if coord and nproc > 1 and pid != "":
+        any_set = bool(coord) or nproc > 0 or pid != ""
+        all_set = bool(coord) and nproc > 0 and pid != ""
+        if any_set and not all_set:
+            # Half-set cluster config must fail loudly: silently skipping
+            # initialize would leave this worker at world 1 computing local
+            # results while its peers block waiting for it.
+            raise RuntimeError(
+                "incomplete jax.distributed settings: coordinator="
+                f"{coord!r} num_processes={nproc} process_id={pid!r} — set "
+                "all of JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES / "
+                "JAX_PROCESS_ID (or the rabit_xla_* config keys), or none"
+            )
+        if all_set and nproc > 1:
             try:
                 jax.distributed.initialize(coord, nproc, int(pid))
             except RuntimeError as exc:

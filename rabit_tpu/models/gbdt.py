@@ -311,6 +311,65 @@ def train_round_fused(
     return TrainState(forest=forest, margin=margin, round=t + 1)
 
 
+def train_round_hybrid(
+    state: TrainState,
+    xb: jax.Array,
+    y: jax.Array,
+    cfg: GBDTConfig,
+    mesh=None,
+    dp_axis: str = "dp",
+    engine_allreduce: Callable[[np.ndarray], np.ndarray] | None = None,
+) -> TrainState:
+    """One boosting round for the HYBRID deployment: XLA data plane married
+    to the fault-tolerant native engine (the reference's recovery seam,
+    allreduce_robust.cc:687-725, which round-2's review named the last
+    first-order gap).
+
+    The whole round is ONE jitted XLA program: per level, local histograms
+    are built under ``shard_map`` with an in-graph ``psum`` over the
+    intra-host device mesh, and the cross-worker hop crosses the robust
+    TCP engine through a host callback.  The callbacks are ordered by data
+    dependence — level d's combined histogram feeds level d+1's routing —
+    so every worker issues the identical deterministic collective sequence,
+    which is exactly what lets the robust engine's replay log serve
+    byte-identical results to a worker recovering mid-round.
+
+    ``engine_allreduce`` is a host fn ``np.ndarray -> np.ndarray`` (e.g.
+    ``lambda a: rabit_tpu.allreduce(a, rt.SUM)``); None means solo (the
+    callback is omitted entirely, keeping the program pure for dryruns).
+    """
+
+    def cross(a: jax.Array) -> jax.Array:
+        if engine_allreduce is None:
+            return a
+        return jax.pure_callback(
+            lambda x: np.asarray(engine_allreduce(np.asarray(x)), dtype=x.dtype),
+            jax.ShapeDtypeStruct(a.shape, a.dtype),
+            a,
+        )
+
+    if mesh is None:
+        hist_fn = lambda xb_, g, h, node, nn, nb: cross(
+            node_histograms(xb_, g, h, node, nn, nb)
+        )
+    else:
+        from jax.sharding import PartitionSpec as P
+
+        def hist_fn(xb_, g, h, node, nn, nb):
+            local = jax.shard_map(
+                lambda a, b, c, d: lax.psum(
+                    node_histograms(a, b, c, d, nn, nb), dp_axis
+                ),
+                mesh=mesh,
+                in_specs=(P(dp_axis, None), P(dp_axis), P(dp_axis), P(dp_axis)),
+                out_specs=P(),
+                check_vma=False,
+            )(xb_, g, h, node)
+            return cross(local)
+
+    return train_round(state, xb, y, cfg, hist_fn, cross)
+
+
 def train_round_dp_fused(state, xb3, y, cfg, dp_axis: str = "dp",
                          interpret: bool = False):
     """train_round_fused wired for shard_map: row blocks sharded over

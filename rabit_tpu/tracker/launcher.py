@@ -37,6 +37,7 @@ class LocalCluster:
         self.extra_env = extra_env or {}
         self.restarts = [0] * num_workers
         self.returncodes: list[int | None] = [None] * num_workers
+        self.messages: list[str] = []  # tracker print log of the last run
 
     def _spawn(self, cmd: list[str], tracker: Tracker, i: int) -> subprocess.Popen:
         env = dict(os.environ)
@@ -54,6 +55,7 @@ class LocalCluster:
         every worker exited cleanly; raises on restart-budget exhaustion or
         timeout."""
         tracker = Tracker(self.num_workers, quiet=self.quiet).start()
+        self.messages = tracker.messages
         procs = [self._spawn(cmd, tracker, i) for i in range(self.num_workers)]
         deadline = time.monotonic() + timeout
         try:

@@ -14,6 +14,7 @@ bumped epoch.
 
 from __future__ import annotations
 
+import os
 import socket
 import threading
 from dataclasses import dataclass
@@ -30,11 +31,93 @@ class _Pending:
     prev_rank: int
 
 
+def assign_ranks(
+    wave: list[tuple[str, str]],
+    world_size: int,
+    prev_ranks: dict[str, int],
+    host_order: list[str] | None = None,
+) -> dict[str, int]:
+    """Topology-aware rank assignment (pure, unit-testable).
+
+    ``wave`` is ``[(task_id, host), ...]`` in check-in order.  Precedence:
+
+    1. stable re-admission — a task id seen before keeps its rank (the
+       reference tracker's recover contract, ReConnectLinks
+       allreduce_base.cc:263-438);
+    2. launcher-numbered ids — ``int(task_id)`` when valid and free, so
+       mock-kill specs and restart counters line up;
+    3. the rest are grouped BY HOST and handed contiguous free ranks, so
+       ring neighbors (rank±1) and tree subtrees stay on one host and
+       cross-host traffic rides as few DCN hops as possible (the reference
+       tracker is host-blind here; BASELINE north star: topology-aware).
+
+    ``host_order`` ranks the host groups (e.g. a TPU slice's physical
+    worker order, see tpu_slice_host_order); unlisted hosts follow in
+    first-seen order.
+    """
+    ranks: dict[str, int] = {}
+    taken: set[int] = set()
+    for task_id, _host in wave:
+        if task_id in prev_ranks:
+            ranks[task_id] = prev_ranks[task_id]
+            taken.add(prev_ranks[task_id])
+    for task_id, _host in wave:
+        if task_id in ranks:
+            continue
+        try:
+            cand = int(task_id)
+        except ValueError:
+            continue
+        if 0 <= cand < world_size and cand not in taken:
+            ranks[task_id] = cand
+            taken.add(cand)
+    # Host-grouped fill of the remaining slots.
+    order_index = {h: i for i, h in enumerate(host_order or [])}
+    groups: dict[str, list[str]] = {}
+    first_seen: dict[str, int] = {}
+    for i, (task_id, host) in enumerate(wave):
+        if task_id in ranks:
+            continue
+        groups.setdefault(host, []).append(task_id)
+        first_seen.setdefault(host, i)
+    free = iter(r for r in range(world_size) if r not in taken)
+    for host in sorted(
+        groups, key=lambda h: (order_index.get(h, len(order_index)), first_seen[h])
+    ):
+        for task_id in groups[host]:
+            ranks[task_id] = next(free)
+    return ranks
+
+
+def tpu_slice_host_order() -> list[str] | None:
+    """Physical host order of the current TPU slice from TPU-VM metadata.
+
+    Cloud TPU VMs export ``TPU_WORKER_HOSTNAMES`` (comma-separated, in
+    worker-id order — which walks the slice's ICI topology) and
+    ``TPU_WORKER_ID``.  Ordering tracker ranks along it lays the rabit ring
+    over ICI neighbors instead of arbitrary DCN paths (BASELINE north star:
+    "tracker discovers v5e pod topology").  Returns None off-TPU.
+    """
+    names = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+    hosts = [h.strip() for h in names.split(",") if h.strip()]
+    return hosts or None
+
+
 class Tracker:
     def __init__(self, world_size: int, host: str = "127.0.0.1", port: int = 0,
-                 quiet: bool = False):
+                 quiet: bool = False, topology: str = "auto",
+                 host_order: list[str] | None = None):
         self.world_size = world_size
         self.quiet = quiet
+        # topology: "auto" uses TPU slice metadata when present, "tpu"
+        # requires it, anything else is plain host grouping.
+        if host_order is None and topology in ("auto", "tpu"):
+            host_order = tpu_slice_host_order()
+            if topology == "tpu" and host_order is None:
+                raise RuntimeError(
+                    "topology='tpu' but TPU_WORKER_HOSTNAMES is not set"
+                )
+        self.host_order = host_order
         self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._srv.bind((host, port))
@@ -130,26 +213,16 @@ class Tracker:
         self._assign_and_send(wave, epoch)
 
     def _assign_and_send(self, wave: list[_Pending], epoch: int) -> None:
-        # Stable ranks: task ids seen before keep their rank (re-admission of
-        # a restarted worker, reference ReConnectLinks "recover").  New ids
-        # get rank == int(task_id) when the launcher numbered them (so
-        # mock-kill specs and launcher restart counters line up), otherwise
-        # fill free slots in check-in order.
-        taken = {self._ranks[p.task_id] for p in wave if p.task_id in self._ranks}
-        for p in wave:
-            if p.task_id in self._ranks:
-                continue
-            try:
-                cand = int(p.task_id)
-            except ValueError:
-                continue
-            if 0 <= cand < self.world_size and cand not in taken:
-                self._ranks[p.task_id] = cand
-                taken.add(cand)
-        free = iter(r for r in range(self.world_size) if r not in taken)
-        for p in wave:
-            if p.task_id not in self._ranks:
-                self._ranks[p.task_id] = next(free)
+        # Stable re-admission > launcher numbering > host-grouped fill; see
+        # assign_ranks for the full policy and rationale.
+        self._ranks.update(
+            assign_ranks(
+                [(p.task_id, p.host) for p in wave],
+                self.world_size,
+                self._ranks,
+                host_order=self.host_order,
+            )
+        )
         peers = {
             self._ranks[p.task_id]: (p.host, p.listen_port) for p in wave
         }

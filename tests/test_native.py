@@ -69,6 +69,15 @@ def test_cluster_large_payload_ring_path():
     run_cluster(4, worker_args=[100_000])
 
 
+def test_cluster_reduce_buffer_budget():
+    """A tiny rabit_reduce_buffer forces sub-chunked staging on both the
+    tree and ring paths (reference 256MB ring-buffer flow control,
+    allreduce_base.h:298-398) without changing any result."""
+    run_cluster(4, worker_args=[100_000, "rabit_reduce_buffer=4K",
+                                "rabit_reduce_ring_mincount=1"])
+    run_cluster(3, worker_args=[50_000, "rabit_reduce_buffer=1K"])
+
+
 def test_cluster_tiny_world():
     run_cluster(1)
 

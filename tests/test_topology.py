@@ -1,0 +1,74 @@
+"""Topology-aware tracker rank assignment (pure-function tests).
+
+The reference tracker is host-blind (SURVEY.md weak point; BASELINE north
+star asks for TPU-pod topology discovery).  assign_ranks groups new workers
+by host so the ring (rank±1) crosses hosts as rarely as possible, and
+tpu_slice_host_order orders the host groups along the TPU slice's physical
+worker order."""
+
+from __future__ import annotations
+
+from rabit_tpu.tracker.tracker import Tracker, assign_ranks, tpu_slice_host_order
+
+
+def ring_cross_host_edges(ranks: dict[str, int], hosts: dict[str, str]) -> int:
+    n = len(ranks)
+    by_rank = {r: hosts[t] for t, r in ranks.items()}
+    return sum(1 for r in range(n) if by_rank[r] != by_rank[(r + 1) % n])
+
+
+def test_host_grouping_minimizes_ring_crossings():
+    # check-in order interleaves two hosts; grouped assignment must give
+    # each host a contiguous rank block => exactly 2 cross-host ring edges.
+    wave = [("w0", "hostB"), ("w1", "hostA"), ("w2", "hostB"), ("w3", "hostA")]
+    ranks = assign_ranks(wave, 4, {})
+    hosts = dict(wave)
+    assert ring_cross_host_edges(ranks, hosts) == 2
+    # within a host, ranks are contiguous
+    ra = sorted(r for t, r in ranks.items() if hosts[t] == "hostA")
+    rb = sorted(r for t, r in ranks.items() if hosts[t] == "hostB")
+    assert ra == list(range(ra[0], ra[0] + 2))
+    assert rb == list(range(rb[0], rb[0] + 2))
+
+
+def test_stable_readmission_beats_grouping():
+    wave = [("a", "h1"), ("b", "h2"), ("c", "h1")]
+    prev = {"b": 0}
+    ranks = assign_ranks(wave, 3, prev)
+    assert ranks["b"] == 0  # re-admitted worker keeps its rank
+    assert sorted(ranks.values()) == [0, 1, 2]
+
+
+def test_launcher_numbered_ids_keep_their_rank():
+    wave = [("1", "h1"), ("0", "h2"), ("2", "h1")]
+    ranks = assign_ranks(wave, 3, {})
+    assert ranks == {"0": 0, "1": 1, "2": 2}
+
+
+def test_host_order_ranks_slice_neighbors_first():
+    # physical slice order says hostZ comes before hostA: hostZ's workers
+    # must get the lower (earlier-in-ring) ranks despite name/check-in order.
+    wave = [("wa", "hostA"), ("wz", "hostZ"), ("wa2", "hostA"), ("wz2", "hostZ")]
+    ranks = assign_ranks(wave, 4, {}, host_order=["hostZ", "hostA"])
+    assert {ranks["wz"], ranks["wz2"]} == {0, 1}
+    assert {ranks["wa"], ranks["wa2"]} == {2, 3}
+
+
+def test_tpu_slice_host_order_env(monkeypatch):
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "t1k-0, t1k-1 ,t1k-2")
+    assert tpu_slice_host_order() == ["t1k-0", "t1k-1", "t1k-2"]
+    monkeypatch.delenv("TPU_WORKER_HOSTNAMES")
+    assert tpu_slice_host_order() is None
+
+
+def test_tracker_tpu_mode(monkeypatch):
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "h0,h1")
+    t = Tracker(world_size=2, quiet=True, topology="tpu")
+    assert t.host_order == ["h0", "h1"]
+    t.stop()
+    monkeypatch.delenv("TPU_WORKER_HOSTNAMES")
+    try:
+        Tracker(world_size=2, quiet=True, topology="tpu")
+        raise AssertionError("topology='tpu' without metadata must raise")
+    except RuntimeError:
+        pass

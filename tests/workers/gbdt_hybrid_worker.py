@@ -1,0 +1,156 @@
+"""GBDT on the HYBRID deployment: XLA data plane + robust engine control
+plane — the reference's recovery seam (allreduce_robust.cc:687-725) married
+to in-graph device compute.
+
+Each worker process owns a row shard and a LOCAL 2-device mesh; one boosting
+round is ONE jitted XLA program (gbdt.train_round_hybrid) in which per-level
+histograms ride an in-graph ``psum`` over the local mesh and the
+cross-worker hop crosses the fault-tolerant native engine through a host
+callback.  Checkpoints capture DEVICE state: the forest (global model) and
+this rank's boosting margin (local model, ring-replicated to
+rabit_local_replica successors).  Under ``mock=`` kills a worker dies
+mid-round inside the jitted step, the launcher restarts it, the robust
+engine serves the committed forest + this rank's replicated margin, device
+arrays are rebuilt with their shardings, and training resumes — the replay
+log serves the already-combined histograms byte-identically, so the final
+forest must match a run with no failures bit for bit (asserted by
+tests/test_hybrid_recover.py across runs, and across ranks here).
+
+A worker killed inside the callback exits IMMEDIATELY (os._exit): blocking
+XLA's local collective rendezvous for its 60s termination timeout helps
+nobody — a real preemption kills the process outright too.
+"""
+
+import os
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[2]))
+
+from rabit_tpu._platform import force_cpu_platform  # noqa: E402
+
+force_cpu_platform(2)  # the worker's local device mesh
+
+import functools  # noqa: E402
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P  # noqa: E402
+
+import rabit_tpu as rt  # noqa: E402
+from rabit_tpu.models import gbdt  # noqa: E402
+
+
+def getarg(name: str, default: str) -> str:
+    for a in sys.argv[1:]:
+        if a.startswith(name + "="):
+            return a.split("=", 1)[1]
+    return default
+
+
+def check(cond: bool, what: str) -> None:
+    if not cond:
+        raise AssertionError(f"[{rt.get_rank()}] self-check failed: {what}")
+
+
+def make_data(n=400, f=6, seed=7):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f).astype(np.float32)
+    logits = X[:, 0] * X[:, 1] + 0.8 * (X[:, 2] > 0)
+    y = (logits > 0).astype(np.float32)
+    return X, y
+
+
+def pack_forest(forest) -> np.ndarray:
+    return np.concatenate(
+        [np.asarray(a, np.float32).reshape(-1)
+         for a in (forest.feature, forest.threshold, forest.leaf)]
+    )
+
+
+def main() -> int:
+    n_trees = int(getarg("ntrees", "4"))
+    out_path = getarg("out", "")
+    rt.init()
+    rank, world = rt.get_rank(), rt.get_world_size()
+
+    X, y = make_data()
+    cfg = gbdt.GBDTConfig(n_features=X.shape[1], n_trees=n_trees,
+                          depth=3, n_bins=16)
+    edges = gbdt.compute_bin_edges(X, cfg.n_bins)  # same data => same edges
+    Xs, ys = X[rank::world], y[rank::world]
+    # A shard must split evenly over the local device mesh; drop the ragged
+    # tail deterministically (same rows on every life of this rank).
+    n_local = 2
+    keep = len(ys) - len(ys) % n_local
+    Xs, ys = Xs[:keep], ys[:keep]
+
+    mesh = Mesh(np.array(jax.devices()[:n_local]), ("dp",))
+    rows = NamedSharding(mesh, P("dp"))
+    xb = jax.device_put(
+        np.asarray(gbdt.quantize(jnp.asarray(Xs), jnp.asarray(edges))),
+        NamedSharding(mesh, P("dp", None)),
+    )
+    yj = jax.device_put(ys, rows)
+
+    def engine_hook(a: np.ndarray) -> np.ndarray:
+        try:
+            return rt.allreduce(np.asarray(a, np.float32), rt.SUM)
+        except BaseException as e:
+            print(f"[{rank}] dying in engine hook: {e}", file=sys.stderr,
+                  flush=True)
+            os._exit(13)
+
+    step = jax.jit(functools.partial(
+        gbdt.train_round_hybrid, cfg=cfg, mesh=mesh,
+        engine_allreduce=engine_hook,
+    ))
+
+    version, gmodel, margin_np = rt.load_checkpoint(with_local=True)
+    if version == 0:
+        state = gbdt.init_state(cfg, len(ys))
+        state = state._replace(margin=jax.device_put(state.margin, rows))
+    else:
+        # Rebuild DEVICE state from the engine-served blobs: replicated
+        # forest, this rank's ring-replicated margin back onto its local
+        # mesh sharding, round counter from the checkpoint version.
+        check(margin_np is not None, "restarted worker got no local margin")
+        state = gbdt.TrainState(
+            forest=gbdt.Forest(*(jnp.asarray(a) for a in gmodel)),
+            margin=jax.device_put(margin_np, rows),
+            round=jnp.asarray(version, jnp.int32),
+        )
+    check(int(state.round) == version, f"round {int(state.round)} vs {version}")
+
+    for t in range(version, n_trees):
+        state = step(state, xb, yj)
+        rt.checkpoint(
+            tuple(np.asarray(a) for a in state.forest),  # global: the forest
+            np.asarray(state.margin),                    # local: my margin
+        )
+        check(rt.version_number() == t + 1, "version after checkpoint")
+
+    # every worker must have grown the identical forest
+    mine = pack_forest(state.forest)
+    everyone = rt.allgather(mine)
+    for r in range(world):
+        check(np.array_equal(everyone[r], mine), f"forest differs from rank {r}")
+
+    pred = np.asarray(gbdt.predict_margin(state.forest, xb, cfg=cfg)) > 0
+    counts = rt.allreduce(
+        np.array([(pred == ys).sum(), len(ys)], np.float64), rt.SUM
+    )
+    acc = counts[0] / counts[1]
+    check(acc > 0.75, f"train accuracy {acc}")
+    if out_path and rank == 0:
+        np.save(out_path, mine)
+    rt.tracker_print(
+        f"[{rank}] hybrid gbdt verified: {n_trees} trees, acc {acc:.3f}"
+    )
+    rt.finalize()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,124 @@
+#!/usr/bin/env python
+"""Histogram-kernel ablation on the bench workload shape (1M x 28 x 256).
+
+Times the three node_histograms implementations (pallas MXU contraction /
+onehot XLA matmul / scatter segment_sum — rabit_tpu/ops/hist.py) per tree
+level, plus the fused boost kernels' route+hist level step, so the
+committed numbers say WHERE the round time goes (round-2 verdict: "nobody
+can tell whether routing or the histogram contraction dominates").
+
+Run on the real TPU (fresh process, no conftest pinning):
+    python tools/hist_ablation.py [--rows 1000000] [--json-out f.jsonl]
+Use --cpu for a harness smoke test on small shapes.
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import json
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO))
+
+
+def timed(fn, *args, n=5):
+    import jax
+
+    out = fn(*args)
+    jax.device_get(jax.tree.leaves(out)[0])  # compile + warm (axon: readback fences)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = fn(*args)
+    jax.device_get(jax.tree.leaves(out)[0])
+    return (time.perf_counter() - t0) / n
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--rows", type=int, default=1_000_000)
+    ap.add_argument("--feats", type=int, default=28)
+    ap.add_argument("--bins", type=int, default=256)
+    ap.add_argument("--depth", type=int, default=6)
+    ap.add_argument("--cpu", action="store_true")
+    ap.add_argument("--json-out", default="")
+    args = ap.parse_args()
+
+    if args.cpu:
+        from rabit_tpu._platform import force_cpu_platform
+
+        force_cpu_platform(1)
+        args.rows = min(args.rows, 20_000)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from rabit_tpu.ops import boost, hist
+
+    plat = jax.devices()[0].platform
+    print(f"# platform={plat} rows={args.rows} feats={args.feats} "
+          f"bins={args.bins}", file=sys.stderr, flush=True)
+    rng = np.random.RandomState(0)
+    xb = jnp.asarray(
+        rng.randint(0, args.bins, size=(args.rows, args.feats)), jnp.int32)
+    g = jnp.asarray(rng.randn(args.rows), jnp.float32)
+    h = jnp.asarray(rng.rand(args.rows), jnp.float32)
+
+    records = []
+
+    def emit(rec):
+        rec.update(platform=plat, rows=args.rows, feats=args.feats,
+                   bins=args.bins)
+        records.append(rec)
+        print(json.dumps(rec), flush=True)
+
+    impls = {
+        "scatter": hist.node_histograms_scatter,
+        "onehot": hist.node_histograms_onehot,
+    }
+    if plat == "tpu":
+        impls["pallas"] = hist.node_histograms_pallas
+    for d in (0, args.depth - 1):
+        n_nodes = 1 << d
+        node = jnp.asarray(rng.randint(0, n_nodes, size=args.rows), jnp.int32)
+        for name, fn in impls.items():
+            f = jax.jit(functools.partial(
+                fn, n_nodes=n_nodes, n_bins=args.bins))
+            dt = timed(f, xb, g, h, node)
+            emit({"kernel": f"hist_{name}", "n_nodes": n_nodes,
+                  "ms": round(dt * 1e3, 3)})
+
+    # Fused route+hist level step vs the hist alone: the difference is the
+    # routing cost the fused kernel folds into the same HBM pass.
+    if plat == "tpu":
+        xb3, _ = boost.block_rows(xb)
+        g3, _ = boost.block_rows(g)
+        h3, _ = boost.block_rows(h)
+        for d in (1, args.depth - 1):
+            n_nodes = 1 << (d - 1)
+            node3 = jnp.asarray(
+                rng.randint(0, n_nodes, size=g3.shape), jnp.int32)
+            # level-(d-1) split tables, shape [2**(d-1)] (boost.hist_level)
+            feat = jnp.asarray(
+                rng.randint(0, args.feats, size=1 << (d - 1)), jnp.int32)
+            thr = jnp.asarray(
+                rng.randint(0, args.bins, size=1 << (d - 1)), jnp.int32)
+            f = jax.jit(functools.partial(
+                boost.hist_level, depth=d, n_bins=args.bins))
+            dt = timed(f, xb3, node3, g3, h3, feat, thr)
+            emit({"kernel": "fused_route+hist", "level": d,
+                  "n_nodes_out": 1 << d, "ms": round(dt * 1e3, 3)})
+
+    if args.json_out:
+        out = Path(args.json_out)
+        out.parent.mkdir(parents=True, exist_ok=True)
+        out.write_text("\n".join(json.dumps(r) for r in records) + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,14 +1,18 @@
 #!/usr/bin/env python
 """Sweep the native collective micro-benchmark over payload sizes and world
 sizes (parity with /root/reference/test/speed_runner.py's 10^4-10^7 float x
-host grid, run as local processes instead of a hostfile cluster).
+host grid, run as local processes instead of a hostfile cluster), emitting
+one JSON line per (engine, world, size, op) with mean latency and MB/s.
 
-    python tools/speed_runner.py [--engines base,robust] [--workers 2,4,8]
+    python tools/speed_runner.py [--engines base,robust] [--workers 2,4,8] \
+        [--json-out RESULTS/speed.jsonl]
 """
 
 from __future__ import annotations
 
 import argparse
+import json
+import re
 import subprocess
 import sys
 from pathlib import Path
@@ -20,6 +24,12 @@ from rabit_tpu.tracker.launcher import LocalCluster  # noqa: E402
 
 BIN = REPO / "native" / "tests" / "speed_test.run"
 
+# "allreduce-max: mean=0.000123s sigma=1.2e-05 bytes=40000 speed=325.20 MB/s"
+_LINE = re.compile(
+    r"(?P<op>[\w-]+)\s*: mean=(?P<mean>[\d.e+-]+)s sigma=(?P<sigma>[\d.e+-]+) "
+    r"bytes=(?P<bytes>\d+) speed=(?P<mbps>[\d.e+-]+) MB/s"
+)
+
 
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
@@ -27,22 +37,42 @@ def main() -> int:
     ap.add_argument("--workers", default="2,4,8")
     ap.add_argument("--sizes", default="10000,100000,1000000,10000000")
     ap.add_argument("--nrep", type=int, default=10)
+    ap.add_argument("--json-out", default="")
     args = ap.parse_args()
 
     subprocess.run(
         ["make", "-C", str(REPO / "native"), "tests/speed_test.run"], check=True
     )
+    records = []
     for engine in args.engines.split(","):
         for nworkers in map(int, args.workers.split(",")):
             for ndata in map(int, args.sizes.split(",")):
-                print(f"== engine={engine} workers={nworkers} ndata={ndata}",
-                      flush=True)
                 cluster = LocalCluster(nworkers, quiet=True)
                 cluster.run(
                     [str(BIN), f"ndata={ndata}", f"nrep={args.nrep}",
                      f"rabit_engine={engine}"],
                     timeout=600,
                 )
+                for msg in cluster.messages:
+                    m = _LINE.search(msg)
+                    if not m:
+                        continue
+                    rec = {
+                        "engine": engine,
+                        "world": nworkers,
+                        "ndata": ndata,
+                        "op": m.group("op"),
+                        "mean_s": float(m.group("mean")),
+                        "sigma_s": float(m.group("sigma")),
+                        "bytes": int(m.group("bytes")),
+                        "mb_per_s": float(m.group("mbps")),
+                    }
+                    records.append(rec)
+                    print(json.dumps(rec), flush=True)
+    if args.json_out:
+        out = Path(args.json_out)
+        out.parent.mkdir(parents=True, exist_ok=True)
+        out.write_text("\n".join(json.dumps(r) for r in records) + "\n")
     return 0
 
 
