@@ -56,7 +56,10 @@ DEFAULTS: dict[str, str] = {
     "rabit_local_replica": "2",
     "rabit_timeout": "1",
     "rabit_timeout_sec": "1800",
-    "rabit_stall_timeout_sec": "300",
+    # rabit_stall_timeout_sec is deliberately NOT defaulted here: its
+    # default is engine-dependent (robust: 300s, base: off — see
+    # Comm::SetDefaultStallSec), and a value here would be serialized into
+    # RabitInit argv and override that.
     "rabit_bootstrap_cache": "0",
     "rabit_debug": "0",
     "rabit_enable_tcp_no_delay": "0",

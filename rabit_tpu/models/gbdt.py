@@ -157,6 +157,27 @@ def best_splits(hist: jax.Array, cfg: GBDTConfig):
     )
 
 
+def split_child_masses(hist: jax.Array, feat: jax.Array, thr: jax.Array) -> jax.Array:
+    """Leaf (g, h) masses read off the parent histogram at the chosen split
+    — XGBoost's histogram identity (children sums = split cumsums), so the
+    leaf fit needs no extra row pass over the data.  ``hist`` is the final
+    level's COMBINED [n_nodes, F, B, 2] histogram; returns [2*n_nodes, 2]
+    interleaved (left_0, right_0, left_1, right_1, ...) in leaf order
+    (leaf = 2*node + went_right)."""
+    g, h = hist[..., 0], hist[..., 1]                  # [nodes, F, B]
+    GL, HL = jnp.cumsum(g, -1), jnp.cumsum(h, -1)
+    G, H = GL[..., -1], HL[..., -1]                    # [nodes, F]
+    n_nodes = hist.shape[0]
+    rows = jnp.arange(n_nodes)
+    gl = GL[rows, feat, thr]
+    hl = HL[rows, feat, thr]
+    gt = G[rows, feat]
+    ht = H[rows, feat]
+    left = jnp.stack([gl, hl], -1)                     # [nodes, 2]
+    right = jnp.stack([gt - gl, ht - hl], -1)
+    return jnp.stack([left, right], axis=1).reshape(2 * n_nodes, 2)
+
+
 # -- training --------------------------------------------------------------
 
 
@@ -255,10 +276,13 @@ def train_round_fused(
 ) -> TrainState:
     """One boosting round via the fused Pallas kernels (ops.boost): routing,
     split lookup, and histogram accumulation run in one streaming pass per
-    level, so rows cross HBM depth+1 times per round instead of ~3x depth.
+    level, so rows cross HBM depth+1 times per round (depth histogram
+    passes + one routing-only leaf pass) instead of ~3x depth.
 
     ``xb3`` is the pre-blocked quantized matrix from ``ops.boost.block_rows``
-    (built once per fit).  ``combine`` is the histogram/leaf allreduce hook
+    (built once per fit).  ``combine`` is the histogram allreduce hook
+    (one call per level; leaf masses derive from the last combined
+    histogram via split_child_masses, so there is no leaf collective)
     (e.g. ``lambda a: lax.psum(a, 'dp')`` under shard_map) — the same single
     communication point per level as the reference workload.
     """
@@ -292,9 +316,14 @@ def train_round_fused(
         feat, thr, _ = best_splits(hist, cfg)
         feats.append(jnp.zeros(max_nodes, jnp.int32).at[: 2 ** d].set(feat))
         thrs.append(jnp.zeros(max_nodes, jnp.int32).at[: 2 ** d].set(thr))
-    leaf_gh, node3 = boost.leaf_fit(xb3, node3, g3, h3, feat, thr,
-                                    depth=cfg.depth, interpret=interpret)
-    leaf_gh = combine(leaf_gh)
+    # Leaf (g, h) masses come straight off the final combined histogram
+    # (split_child_masses) — already globally reduced, so no leaf collective
+    # and no histogram work in the last row pass, which only routes rows to
+    # their leaves for the margin update (depth collectives per round, not
+    # depth+1).
+    leaf_gh = split_child_masses(hist, feat, thr)
+    node3 = boost.route_level(xb3, node3, feat, thr, depth=cfg.depth,
+                              interpret=interpret)
     leaf = -cfg.learning_rate * leaf_gh[:, 0] / (leaf_gh[:, 1] + cfg.reg_lambda)
     node = boost.unblock_rows(node3, n)
     margin = state.margin + leaf[node]
@@ -339,18 +368,28 @@ def train_round_hybrid(
     callback is omitted entirely, keeping the program pure for dryruns).
     """
 
-    def cross(a: jax.Array) -> jax.Array:
+    def cross(a: jax.Array, tag: int) -> jax.Array:
         if engine_allreduce is None:
             return a
+        # `tag` is a per-call-site constant operand: two levels of one
+        # round can produce IDENTICAL histograms (degenerate shards), and
+        # pure_callback's contract would let XLA CSE the two "pure" calls
+        # into one host call — desynchronizing the engine's collective
+        # sequence across workers.  Distinct constant operands make the
+        # calls distinct HLO ops, so each level's engine hop always fires.
+        # (io_callback(ordered=True) would be the canonical primitive, but
+        # XLA's SPMD partitioner rejects side-effecting ops with the
+        # replicated shardings this program needs.)
         return jax.pure_callback(
-            lambda x: np.asarray(engine_allreduce(np.asarray(x)), dtype=x.dtype),
+            lambda x, _t: np.asarray(engine_allreduce(np.asarray(x)), dtype=x.dtype),
             jax.ShapeDtypeStruct(a.shape, a.dtype),
             a,
+            np.int32(tag),
         )
 
     if mesh is None:
         hist_fn = lambda xb_, g, h, node, nn, nb: cross(
-            node_histograms(xb_, g, h, node, nn, nb)
+            node_histograms(xb_, g, h, node, nn, nb), nn
         )
     else:
         from jax.sharding import PartitionSpec as P
@@ -365,16 +404,17 @@ def train_round_hybrid(
                 out_specs=P(),
                 check_vma=False,
             )(xb_, g, h, node)
-            return cross(local)
+            return cross(local, nn)  # nn = 2**level: unique per level
 
-    return train_round(state, xb, y, cfg, hist_fn, cross)
+    return train_round(state, xb, y, cfg, hist_fn,
+                       functools.partial(cross, tag=-1))
 
 
 def train_round_dp_fused(state, xb3, y, cfg, dp_axis: str = "dp",
                          interpret: bool = False):
     """train_round_fused wired for shard_map: row blocks sharded over
     ``dp_axis`` (shard xb3 on its leading block dim, margin/y on rows); one
-    psum per tree level + one for the leaf fit — identical communication
+    psum per tree level (leaf masses ride the last one) — communication
     placement to train_round_dp, with the fused kernels doing the local
     work."""
     return train_round_fused(

@@ -58,9 +58,13 @@ def assign_ranks(
     ranks: dict[str, int] = {}
     taken: set[int] = set()
     for task_id, _host in wave:
-        if task_id in prev_ranks:
-            ranks[task_id] = prev_ranks[task_id]
-            taken.add(prev_ranks[task_id])
+        prev = prev_ranks.get(task_id)
+        # Two task ids can hold the SAME stale rank (one freed it in an
+        # earlier wave, another inherited it, then the first rejoins):
+        # first-in-wave wins, the other falls through to a fresh slot.
+        if prev is not None and 0 <= prev < world_size and prev not in taken:
+            ranks[task_id] = prev
+            taken.add(prev)
     for task_id, _host in wave:
         if task_id in ranks:
             continue

@@ -138,9 +138,12 @@ def test_config_layering():
     assert parse_unit("1K") == 1024
     assert parse_unit("2M") == 2 << 20
     assert parse_unit("512") == 512
-    assert cfg.timeout_sec == 0
+    # Watchdog is armed by default since round 3 (1800s); rabit_timeout=0
+    # disables it.
+    assert cfg.timeout_sec == 1800
     cfg2 = Config(["rabit_timeout=1", "rabit_timeout_sec=300"])
     assert cfg2.timeout_sec == 300
+    assert Config(["rabit_timeout=0"]).timeout_sec == 0
 
 
 def test_config_env_layering(monkeypatch):

@@ -31,6 +31,16 @@ def test_host_grouping_minimizes_ring_crossings():
     assert rb == list(range(rb[0], rb[0] + 2))
 
 
+def test_stale_rank_collision_resolves():
+    # wave1 {a,b}->{0,1}; b died and c inherited rank 1; now a is gone and
+    # b rejoins: prev_ranks holds rank 1 for BOTH b and c.  One keeps it,
+    # the other gets the free slot — never a duplicate assignment.
+    prev = {"a": 0, "b": 1, "c": 1}
+    ranks = assign_ranks([("b", "h"), ("c", "h")], 2, prev)
+    assert sorted(ranks.values()) == [0, 1]
+    assert ranks["b"] == 1  # first in wave wins its old rank
+
+
 def test_stable_readmission_beats_grouping():
     wave = [("a", "h1"), ("b", "h2"), ("c", "h1")]
     prev = {"b": 0}

@@ -1,0 +1,77 @@
+#!/usr/bin/env python
+"""Anchored CPU baseline: scikit-learn's HistGradientBoostingClassifier
+(a production Cython implementation of the same hist algorithm family as
+XGBoost's `hist` tree_method) on the driver-bench shape — so the committed
+speedups are measured against a real library, not only the hand-rolled
+numpy round in bench.py (round-2 review: "the baseline is hand-rolled
+numpy rather than an actual XGBoost hist run"; xgboost itself is not in
+this image).
+
+Per-round time is isolated by differencing two fits (binning and setup
+cancel): (fit(max_iter=hi) - fit(max_iter=lo)) / (hi - lo).
+
+    python tools/sklearn_baseline.py [--rows 1000000] [--json-out f.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--rows", type=int, default=1_000_000)
+    ap.add_argument("--feats", type=int, default=28)
+    ap.add_argument("--depth", type=int, default=6)
+    ap.add_argument("--lo", type=int, default=4)
+    ap.add_argument("--hi", type=int, default=12)
+    ap.add_argument("--json-out", default="")
+    args = ap.parse_args()
+
+    from sklearn.ensemble import HistGradientBoostingClassifier
+    import sklearn
+
+    rng = np.random.RandomState(0)
+    # Same synthetic generator as bench.py (bin ids as float features).
+    xb = rng.randint(0, 256, size=(args.rows, args.feats)).astype(np.float32)
+    logits = (xb[:, 0] > 128).astype(np.float32) + 0.01 * xb[:, 1]
+    y = (logits + rng.randn(args.rows) > 1.5).astype(np.int32)
+
+    def fit_time(n_iter: int) -> float:
+        clf = HistGradientBoostingClassifier(
+            max_iter=n_iter, max_depth=args.depth, max_leaf_nodes=None,
+            max_bins=255, early_stopping=False, validation_fraction=None,
+        )
+        t0 = time.perf_counter()
+        clf.fit(xb, y)
+        return time.perf_counter() - t0
+
+    fit_time(1)  # warm allocators/threads
+    t_lo = fit_time(args.lo)
+    t_hi = fit_time(args.hi)
+    per_round = (t_hi - t_lo) / (args.hi - args.lo)
+    rec = {
+        "baseline": "sklearn.HistGradientBoostingClassifier",
+        "version": sklearn.__version__,
+        "rows": args.rows,
+        "feats": args.feats,
+        "depth": args.depth,
+        "per_round_s": round(per_round, 4),
+        "rounds_per_sec": round(1.0 / per_round, 3),
+        "fit_lo_s": round(t_lo, 2),
+        "fit_hi_s": round(t_hi, 2),
+    }
+    print(json.dumps(rec), flush=True)
+    if args.json_out:
+        with open(args.json_out, "w") as f:
+            json.dump(rec, f)
+    return 0
+
+
+if __name__ == "__main__":
+    sys_exit = main()
+    raise SystemExit(sys_exit)
