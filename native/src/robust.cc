@@ -723,7 +723,7 @@ class RobustEngine : public Engine {
                   "bootstrap replay size mismatch for '%s': %zu != %zu",
                   key.c_str(), op->nbytes, val.size());
         memcpy(op->buf, val.data(), val.size());
-        CommitResult(op, val);
+        CommitResult(op, &val);
         op->served = true;
       }
     }
@@ -762,7 +762,7 @@ class RobustEngine : public Engine {
                 "op sequence?)",
                 s, op->nbytes, val.size());
       memcpy(op->buf, val.data(), val.size());
-      CommitResult(op, val);
+      CommitResult(op, &val);
       op->served = true;
     }
     return IoResult::kOk;
@@ -770,38 +770,40 @@ class RobustEngine : public Engine {
 
   // --- live execution -----------------------------------------------------
 
-  // Run the collective on a scratch copy so a half-finished attempt never
-  // corrupts the retry input (the reference runs ops in resbuf temp space
-  // for the same reason, allreduce_robust.cc:276-288).
+  // Run the collective IN PLACE, with one pristine-input copy for retries
+  // (a failed attempt leaves op->buf partially reduced).  The reference
+  // stages ops in resbuf temp space instead (allreduce_robust.cc:276-288);
+  // in-place + one saved copy does fewer big memcpys on the success path,
+  // and scratch_ is a reused member so large ops don't re-allocate.
   void RunLive(OpCtx* op, const std::function<IoResult(char*)>& body) {
-    std::string scratch;
-    while (true) {
-      scratch.assign(op->buf, op->nbytes);
-      if (body(scratch.data()) == IoResult::kOk) break;
+    scratch_.assign(op->buf, op->nbytes);
+    while (body(op->buf) != IoResult::kOk) {
       CheckAndRecover();
       if (RecoverExec(op, 0)) return;  // a peer finished it; result adopted
+      memcpy(op->buf, scratch_.data(), op->nbytes);  // roll back the attempt
     }
-    memcpy(op->buf, scratch.data(), op->nbytes);
-    CommitResult(op, scratch);
+    CommitResult(op, nullptr);
   }
 
-  // Record a completed op: replay log with rotating-replica retention (each
-  // seqno is retained by ~num_global_replica ranks; reference drop rule,
-  // allreduce_robust.cc:269-273) and the bootstrap cache for
-  // pre-LoadCheckPoint ops.
-  void CommitResult(OpCtx* op, const std::string& result) {
-    resbuf_[seqno_] = result;
-    for (auto rit = resbuf_.begin(); rit != resbuf_.end();) {
-      if (rit->first != seqno_ &&
-          rit->first % static_cast<uint32_t>(result_round_) !=
-              static_cast<uint32_t>(comm_.rank() % result_round_)) {
-        rit = resbuf_.erase(rit);
-      } else {
-        ++rit;
-      }
-    }
+  // Record a completed op in the replay log with rotating-replica
+  // retention: each seqno is retained by ~num_global_replica ranks
+  // (reference drop rule, allreduce_robust.cc:269-273); non-owners skip
+  // the store entirely.  ``result`` may be null (the result lives in
+  // op->buf after an in-place run) and is consumed by move when given.
+  // Also feeds the bootstrap cache for pre-LoadCheckPoint ops.
+  void CommitResult(OpCtx* op, std::string* result) {
     if (!loaded_ && boot_cache_on_ && !op->key.empty()) {
-      boot_cache_[BootKey(op->key)] = result;
+      boot_cache_[BootKey(op->key)] =
+          result != nullptr ? *result : std::string(op->buf, op->nbytes);
+    }
+    bool own = seqno_ % static_cast<uint32_t>(result_round_) ==
+               static_cast<uint32_t>(comm_.rank() % result_round_);
+    if (own) {
+      if (result != nullptr) {
+        resbuf_[seqno_] = std::move(*result);
+      } else {
+        resbuf_[seqno_].assign(op->buf, op->nbytes);
+      }
     }
     ++seqno_;
   }
@@ -977,6 +979,7 @@ class RobustEngine : public Engine {
   int local_replica_cfg_ = 2;
 
   std::map<uint32_t, std::string> resbuf_;  // seqno -> result (this version)
+  std::string scratch_;  // RunLive retry staging, reused across ops
   int num_global_replica_ = 5;
   int result_round_ = 1;
 

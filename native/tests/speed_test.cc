@@ -68,6 +68,22 @@ int main(int argc, char* argv[]) {
     t0 = NowSec();
     tpurabit::Broadcast(buf.data(), ndata * sizeof(float), 0);
     t_bcast += NowSec() - t0;
+
+    // Checkpoint per iteration like a real training loop (reference
+    // model_recover does too): under the robust engine this clears the
+    // replay log, so the bench measures per-op overhead rather than the
+    // memory blowup of an unbounded never-checkpointed log.
+    struct IterModel : tpurabit::Serializable {
+      int iter = 0;
+      void Save(tpurabit::Stream* fo) const override {
+        fo->Write(&iter, sizeof(iter));
+      }
+      void Load(tpurabit::Stream* fi) override {
+        fi->Read(&iter, sizeof(iter));
+      }
+    } model;
+    model.iter = r;
+    tpurabit::CheckPoint(&model);
   }
   PrintStats("allreduce-max", t_max, nrep, ndata * sizeof(float));
   PrintStats("allreduce-sum", t_sum, nrep, ndata * sizeof(float));
