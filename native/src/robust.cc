@@ -217,7 +217,10 @@ class RobustEngine : public Engine {
     double t0 = NowSec();
     OpCtx op{static_cast<char*>(buf), size, Key(cache_key)};
     if (!RecoverExec(&op, 0)) {
-      RunLive(&op, [&](char* s) { return comm_.Broadcast(s, size, root); });
+      // No rollback span: a failed broadcast attempt is simply re-received
+      // (the root's buffer is never modified, receivers' is all output).
+      RunLive(&op, [&](char* s) { return comm_.Broadcast(s, size, root); },
+              /*save_off=*/0, /*save_len=*/0);
     }
     LogOp("broadcast", op, t0);
   }
@@ -228,6 +231,8 @@ class RobustEngine : public Engine {
     double t0 = NowSec();
     OpCtx op{static_cast<char*>(buf), total, Key(cache_key)};
     if (!RecoverExec(&op, 0)) {
+      // Only this rank's input slice [beg, end) needs rollback protection:
+      // the rest of the buffer is pure output.
       RunLive(&op, [&](char* s) {
         std::vector<std::vector<char>> parts;
         IoResult r = comm_.AllgatherV(s + beg, end - beg, &parts);
@@ -240,7 +245,7 @@ class RobustEngine : public Engine {
         }
         TRT_CHECK(off == total, "allgather size mismatch: %zu != %zu", off, total);
         return IoResult::kOk;
-      });
+      }, /*save_off=*/beg, /*save_len=*/end - beg);
     }
     LogOp("allgather", op, t0);
   }
@@ -775,12 +780,17 @@ class RobustEngine : public Engine {
   // stages ops in resbuf temp space instead (allreduce_robust.cc:276-288);
   // in-place + one saved copy does fewer big memcpys on the success path,
   // and scratch_ is a reused member so large ops don't re-allocate.
-  void RunLive(OpCtx* op, const std::function<IoResult(char*)>& body) {
-    scratch_.assign(op->buf, op->nbytes);
+  void RunLive(OpCtx* op, const std::function<IoResult(char*)>& body,
+               size_t save_off = 0, size_t save_len = SIZE_MAX) {
+    // [save_off, save_off+save_len) is the input span a failed attempt can
+    // corrupt (default: everything, for allreduce's in-place reduction);
+    // broadcast saves nothing, allgather only its own slice.
+    if (save_len == SIZE_MAX) save_len = op->nbytes;
+    scratch_.assign(op->buf + save_off, save_len);
     while (body(op->buf) != IoResult::kOk) {
       CheckAndRecover();
       if (RecoverExec(op, 0)) return;  // a peer finished it; result adopted
-      memcpy(op->buf, scratch_.data(), op->nbytes);  // roll back the attempt
+      memcpy(op->buf + save_off, scratch_.data(), save_len);  // roll back
     }
     CommitResult(op, nullptr);
   }

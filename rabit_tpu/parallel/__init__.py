@@ -18,7 +18,11 @@ from rabit_tpu.parallel.collectives import (
     ring_allreduce,
     fused_allreduce,
 )
-from rabit_tpu.parallel.ring import ring_attention, reference_attention
+from rabit_tpu.parallel.ring import (
+    reference_attention,
+    ring_attention,
+    ulysses_attention,
+)
 
 __all__ = [
     "create_mesh",
@@ -36,5 +40,6 @@ __all__ = [
     "ring_allreduce",
     "fused_allreduce",
     "ring_attention",
+    "ulysses_attention",
     "reference_attention",
 ]

@@ -96,9 +96,53 @@ def ring_attention(
     return (o / l.T[..., None]).astype(q.dtype)
 
 
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str,
+    causal: bool = False,
+) -> jax.Array:
+    """All-to-all sequence parallelism (DeepSpeed-Ulysses style) — the
+    other first-class long-context mechanism beside ring_attention.
+
+    One ``all_to_all`` reshards the seq-sharded q/k/v to HEAD-sharded
+    (each device holds the full sequence for heads/n heads), full
+    attention runs locally per head slice, and a second ``all_to_all``
+    reshards back.  Two all-to-alls total vs the ring's n ppermute hops:
+    cheaper when heads >= devices and the full-sequence score block fits
+    memory; ring_attention wins for extreme contexts (O(seq/n) memory).
+
+    Per-device shapes ``[block, heads, dim]`` with ``heads % n == 0``;
+    run inside ``shard_map`` over ``axis_name``.
+    """
+    n = lax.axis_size(axis_name)
+    block, heads, dim = q.shape
+    if heads % n != 0:
+        raise ValueError(
+            f"ulysses_attention needs heads ({heads}) divisible by the "
+            f"'{axis_name}' axis size ({n}); use ring_attention otherwise"
+        )
+    to_heads = lambda x: lax.all_to_all(
+        x, axis_name, split_axis=1, concat_axis=0, tiled=True
+    )  # [block, h, d] -> [n*block, h/n, d]
+    # f32 scores/softmax like ring_attention's accumulators: both
+    # long-context mechanisms must give the same-quality answer for
+    # low-precision inputs.
+    o = reference_attention(
+        to_heads(q).astype(jnp.float32),
+        to_heads(k).astype(jnp.float32),
+        to_heads(v).astype(jnp.float32),
+        causal=causal,
+    ).astype(q.dtype)
+    return lax.all_to_all(o, axis_name, split_axis=0, concat_axis=1, tiled=True)
+
+
 def reference_attention(q, k, v, causal: bool = False) -> jax.Array:
-    """Unsharded full attention, for testing ring_attention.  Shapes
-    ``[seq, heads, dim]``."""
+    """Unsharded full attention, ``[seq, heads, dim]``: the test oracle for
+    ring_attention AND the local per-head-slice compute core of
+    ulysses_attention (which feeds it f32 inputs) — behavior changes here
+    change production output."""
     seq, heads, dim = q.shape
     s = jnp.einsum("qhd,khd->hqk", q, k) / (dim ** 0.5)
     if causal:

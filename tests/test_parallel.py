@@ -159,6 +159,25 @@ def test_ring_attention_matches_reference(mesh, causal):
     np.testing.assert_allclose(out, expect, rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_attention_matches_reference(mesh, causal):
+    rng = np.random.RandomState(6)
+    seq, heads, dim = N * 4, 8, 8  # heads divisible by the 8-device axis
+    q = rng.randn(seq, heads, dim).astype(np.float32)
+    k = rng.randn(seq, heads, dim).astype(np.float32)
+    v = rng.randn(seq, heads, dim).astype(np.float32)
+
+    f = shmap(
+        lambda q, k, v: rp.ulysses_attention(q, k, v, "dp", causal=causal),
+        mesh,
+        (P("dp", None, None),) * 3,
+        P("dp", None, None),
+    )
+    out = np.asarray(f(q, k, v))
+    expect = np.asarray(rp.reference_attention(q, k, v, causal=causal))
+    np.testing.assert_allclose(out, expect, rtol=2e-4, atol=2e-5)
+
+
 def test_lazy_allreduce_fusion_solo():
     from rabit_tpu.fusion import LazyAllreduce
 
