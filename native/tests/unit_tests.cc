@@ -5,6 +5,7 @@
 // kill switch (allreduce_mock_test.cc).  Where the reference flips
 // private->public with a macro, this binary simply #includes robust.cc to
 // reach the internals.
+#include <sys/socket.h>
 #include <sys/types.h>
 #include <sys/wait.h>
 #include <unistd.h>
@@ -145,6 +146,38 @@ TEST(builtin_reducers) {
 
 // --- watchdog (reference: allreduce_robust_test.cc timeout semantics,
 // tested single-process without any cluster) ------------------------------
+
+// --- hung-peer stall detection (round-3 liveness; the reference covered
+// this blind spot with OOB CheckExcept, socket.h:440-533) ----------------
+
+TEST(stall_timeout_reports_peer_failure) {
+  int sv[2];
+  CHECK_TRUE(socketpair(AF_UNIX, SOCK_STREAM, 0, sv) == 0);
+  TcpSocket a(sv[0]), b(sv[1]);
+  a.SetNonBlock(true);
+  char buf[16];
+  // Nothing ever arrives: a wedged peer looks like an open, silent socket.
+  Transfer t{a.fd(), buf, sizeof(buf), 0, /*sending=*/false};
+  double t0 = NowSec();
+  CHECK_TRUE(DriveTransfers(&t, 1, /*timeout_ms=*/100) ==
+             IoResult::kPeerFailure);
+  double dt = NowSec() - t0;
+  CHECK_TRUE(dt >= 0.09 && dt < 5.0);
+  (void)b;
+}
+
+TEST(stall_timeout_progress_resets_nothing_but_completes) {
+  int sv[2];
+  CHECK_TRUE(socketpair(AF_UNIX, SOCK_STREAM, 0, sv) == 0);
+  TcpSocket a(sv[0]), b(sv[1]);
+  a.SetNonBlock(true);
+  const char msg[8] = "1234567";
+  b.SendAll(msg, sizeof(msg));
+  char buf[8];
+  Transfer t{a.fd(), buf, sizeof(buf), 0, /*sending=*/false};
+  CHECK_TRUE(DriveTransfers(&t, 1, /*timeout_ms=*/100) == IoResult::kOk);
+  CHECK_TRUE(memcmp(buf, msg, sizeof(msg)) == 0);
+}
 
 TEST(watchdog_disarm_cancels) {
   Watchdog wd;
