@@ -155,6 +155,26 @@ def device_worker(n_rows, n_rounds, force_cpu):
     print(json.dumps({"device_time": dt, "platform": plat}), flush=True)
 
 
+def probe_device(timeout=45.0) -> bool:
+    """Fast TPU liveness check in a throwaway child: a wedged axon tunnel
+    hangs at backend init (holding jax's lock forever), and burning the
+    full TPU_CHILD_TIMEOUT on it costs 5 minutes before the CPU fallback
+    even starts.  One tiny op under a short timeout answers 'is the
+    backend alive at all' first."""
+    cmd = [sys.executable, "-c",
+           "import jax, jax.numpy as jnp; print(int(jnp.arange(4).sum()))"]
+    try:
+        r = subprocess.run(cmd, timeout=timeout, capture_output=True, text=True)
+    except subprocess.TimeoutExpired:
+        log(f"device probe hung for {timeout:.0f}s (wedged backend)")
+        return False
+    ok = r.returncode == 0 and "6" in r.stdout
+    if not ok:
+        tail = (r.stderr or "").strip().splitlines()[-2:]
+        log(f"device probe failed rc={r.returncode}: {' | '.join(tail)}")
+    return ok
+
+
 def run_child(n_rows, n_rounds, force_cpu, timeout):
     cmd = [sys.executable, os.path.abspath(__file__), "--device-worker",
            str(n_rows), str(n_rounds), str(int(force_cpu))]
@@ -185,7 +205,13 @@ def run_child(n_rows, n_rounds, force_cpu, timeout):
 
 def main():
     log(f"dataset: {N_ROWS} rows x {N_FEATURES} feats, {N_BINS} bins, depth {DEPTH}")
-    res = run_child(N_ROWS, TPU_ROUNDS, force_cpu=False, timeout=TPU_CHILD_TIMEOUT)
+    res = None
+    if not probe_device():
+        # One more chance — transient tunnel hiccups do heal.
+        log("probe failed; retrying probe once")
+        res = "timeout" if not probe_device() else None
+    if res is None:
+        res = run_child(N_ROWS, TPU_ROUNDS, force_cpu=False, timeout=TPU_CHILD_TIMEOUT)
     if res is None:
         # Fast failure (UNAVAILABLE etc.) is often transient: retry once.
         # A hang ("timeout") persists — don't burn another full timeout on it.
