@@ -204,9 +204,11 @@ def checkpoint(global_model: Any, local_model: Any = None) -> None:
 
 def lazy_checkpoint(global_model: Any) -> None:
     """Checkpoint without eager serialization: the model is only pickled if a
-    failure actually needs the blob.  The caller must not mutate
-    ``global_model`` between checkpoints (reference contract,
-    rabit.h:311-332)."""
+    failure actually needs the blob.  Contract (reference rabit.h:311-332):
+    ``global_model`` must stay unchanged until the NEXT checkpoint call
+    RETURNS — recovery during that next call's pre-commit consensus can
+    still serve this version through this call's callback.  Rebind a fresh
+    object per iteration rather than mutating in place."""
     _get_engine().lazy_checkpoint(
         lambda: pickle.dumps(global_model, protocol=pickle.HIGHEST_PROTOCOL)
     )

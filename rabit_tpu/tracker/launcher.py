@@ -38,6 +38,9 @@ class LocalCluster:
         self.restarts = [0] * num_workers
         self.returncodes: list[int | None] = [None] * num_workers
         self.messages: list[str] = []  # tracker print log of the last run
+        # time.time() at each observed worker death (recovery-latency
+        # benchmarks diff these against worker-reported recovery stamps)
+        self.death_times: list[float] = []
 
     def _spawn(self, cmd: list[str], tracker: Tracker, i: int) -> subprocess.Popen:
         env = dict(os.environ)
@@ -81,6 +84,7 @@ class LocalCluster:
                                 f"budget ({self.max_restarts}) exhausted"
                             )
                         self.restarts[i] += 1
+                        self.death_times.append(time.time())
                         if not self.quiet:
                             print(
                                 f"[launcher] worker {i} died (code {ret}); "

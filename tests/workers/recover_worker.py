@@ -18,7 +18,9 @@ Worker args (k=v on the command line, all also forwarded to the engine):
                    (exercises the bootstrap cache)
 """
 
+import os
 import sys
+import time
 
 import numpy as np
 
@@ -70,6 +72,13 @@ def main() -> int:
     check(model["iter"] == version, f"model {model} vs version {version}")
     if use_local:
         check(lmodel["rank"] == rank, f"local model {lmodel} not mine")
+    if int(os.environ.get("DMLC_NUM_ATTEMPT", "0")) > 0:
+        # Restarted life: stamp the moment state was recovered from peers
+        # (tools/recovery_bench.py diffs this against the launcher's
+        # observed death time for protocol-level recovery latency).
+        rt.tracker_print(
+            f"[{rank}] recovered_at={time.time():.6f} version={version}"
+        )
 
     for it in range(version, niter):
         # MAX: data[i] = rank + i + it  ->  world-1 + i + it
@@ -96,10 +105,15 @@ def main() -> int:
         expect = np.array([[r, it, r * it] for r in range(world)], np.int64)
         check(np.array_equal(g, expect), f"iter {it} allgather {g}")
 
-        model["iter"] = it + 1
-        model["history"].append(it)
+        # Rebind a FRESH model object instead of mutating in place: the
+        # lazy-checkpoint contract serializes on demand, and the engine may
+        # still serve the PREVIOUS version (through the previous call's
+        # callback) during this checkpoint's pre-commit consensus — an
+        # in-place mutation here would be served as stale bytes of the old
+        # version (same window as the reference's global_lazycheck).
+        model = {"iter": it + 1, "history": model["history"] + [it]}
         if use_local:
-            lmodel["iter"] = it + 1
+            lmodel = {"rank": rank, "iter": it + 1}
             rt.checkpoint(model, lmodel)
         elif use_lazy:
             rt.lazy_checkpoint(model)
