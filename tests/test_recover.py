@@ -175,3 +175,18 @@ def test_many_iterations_many_deaths():
         max_restarts=10,
         timeout=180.0,
     )
+
+
+def test_reference_scale_10_workers_10k():
+    """The reference's canonical CI gate shape (test/test.mk:14-38 +
+    scripts/travis_runtest.sh): 10 workers x 10k floats x 3 iterations
+    under a 20-restart budget, with multi-rank deaths at the
+    model_recover_10_10k kill points plus a die-hard second kill."""
+    cluster = run_cluster(
+        10,
+        ["niter=3", "ndata=10000",
+         "mock=0,0,1,0;1,1,1,0;4,1,1,0;9,1,1,0;1,1,1,1"],
+        max_restarts=20,
+        timeout=240.0,
+    )
+    assert cluster.restarts[1] == 2  # die-hard: killed again on life 2
