@@ -31,6 +31,32 @@ def test_quantize_roundtrip():
     assert (np.diff(xb[:, 0]) >= 0).all()  # monotone
 
 
+def test_split_child_masses_matches_routed_sums():
+    """The histogram identity behind the routing-only leaf pass: children's
+    (g, h) masses read off the parent histogram at the chosen split must
+    equal direct segment sums over the routed rows."""
+    rng = np.random.RandomState(3)
+    n, F, B, n_nodes = 512, 5, 16, 4
+    xb = jnp.asarray(rng.randint(0, B, size=(n, F)), jnp.int32)
+    g = jnp.asarray(rng.randn(n), jnp.float32)
+    h = jnp.asarray(rng.rand(n), jnp.float32)
+    node = jnp.asarray(rng.randint(0, n_nodes, size=n), jnp.int32)
+    feat = jnp.asarray(rng.randint(0, F, size=n_nodes), jnp.int32)
+    thr = jnp.asarray(rng.randint(0, B, size=n_nodes), jnp.int32)
+
+    hist = gbdt.node_histograms(xb, g, h, node, n_nodes, B)
+    masses = np.asarray(gbdt.split_child_masses(hist, feat, thr))
+
+    # direct: route rows and sum per leaf
+    fsel = np.asarray(feat)[np.asarray(node)]
+    xv = np.asarray(xb)[np.arange(n), fsel]
+    leaf = np.asarray(node) * 2 + (xv > np.asarray(thr)[np.asarray(node)])
+    expect = np.zeros((2 * n_nodes, 2), np.float64)
+    np.add.at(expect[:, 0], leaf, np.asarray(g, np.float64))
+    np.add.at(expect[:, 1], leaf, np.asarray(h, np.float64))
+    np.testing.assert_allclose(masses, expect, rtol=1e-5, atol=1e-5)
+
+
 def test_gbdt_learns():
     X, y = make_synth()
     model = gbdt.GBDT(n_trees=15, depth=4, n_bins=64, learning_rate=0.4).fit(X, y)
