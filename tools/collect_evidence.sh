@@ -1,0 +1,26 @@
+#!/usr/bin/env bash
+# Regenerate every number in RESULTS.md (raw JSON into RESULTS/).
+#
+# CPU benches (always): collective sweep, recovery latency, consensus
+# fast-path, sklearn-anchored baseline.  Run them on an otherwise idle
+# machine — concurrent load pollutes the robust-engine rows.
+#
+# TPU benches (pass --tpu; needs the real chip): histogram-kernel ablation.
+# The driver-bench number itself comes from `python bench.py`.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
+mkdir -p RESULTS
+
+python tools/speed_runner.py --json-out RESULTS/speed.jsonl
+python tools/recovery_bench.py 2 4 8 16 > RESULTS/recovery.jsonl
+{
+  python tools/consensus_bench.py --world 8 --iters 300
+  python tools/consensus_bench.py --world 32 --iters 150
+} > RESULTS/consensus.jsonl
+python tools/sklearn_baseline.py --json-out RESULTS/sklearn_baseline.json
+
+if [[ "${1:-}" == "--tpu" ]]; then
+  python tools/hist_ablation.py --json-out RESULTS/hist_ablation_tpu.jsonl
+fi
+echo "evidence collected under RESULTS/"
