@@ -33,7 +33,15 @@ class _Handle:
 
 class LazyAllreduce:
     """Queue buffers with ``add``; ``flush`` runs one fused allreduce per
-    (dtype, op) group and resolves every handle."""
+    (dtype, op) group and resolves every handle.
+
+    Determinism contract (SURVEY hard part #3 — fusion must not break the
+    robust engine's seqno/replay alignment): groups flush in first-queued
+    order (dict insertion order), so as long as every rank queues the same
+    logical sequence of (dtype, op) buffers — the same requirement plain
+    collectives already have — every rank issues identical fused
+    collectives in identical order, and each fused op gets a deterministic
+    seqno + replayable result like any other."""
 
     def __init__(self, allreduce_fn: Callable[..., np.ndarray] | None = None):
         if allreduce_fn is None:

@@ -73,6 +73,26 @@ def main():
     check(called == [1], "prepare_fun called once")
     check(np.allclose(out, world * (world - 1) / 2), "prepare_fun allreduce")
 
+    # fused lazy allreduce: one collective per (dtype, op) group, across
+    # whatever engine this worker runs (fusion.LazyAllreduce)
+    from rabit_tpu.fusion import LazyAllreduce
+
+    calls = []
+
+    def counting_allreduce(buf, op):
+        calls.append(op)
+        return rt.allreduce(buf, op)
+
+    acc = LazyAllreduce(counting_allreduce)
+    h1 = acc.add(np.full(3, float(rank), np.float64))
+    h2 = acc.add(np.array([rank * 2.0]))             # same f64 SUM group
+    h3 = acc.add(np.array([1 << rank], np.uint32), rt.BITOR)
+    acc.flush()
+    check(len(calls) == 2, "fusion: one collective per (dtype, op) group")
+    check(np.allclose(h1.get(), world * (world - 1) / 2), "fused sum a")
+    check(np.allclose(h2.get(), world * (world - 1)), "fused sum b")
+    check(h3.get()[0] == (1 << world) - 1, "fused bitor")
+
     # checkpoint / load_checkpoint roundtrip (every backend must version and
     # return committed state, even those without cross-process recovery)
     v0, m0 = rt.load_checkpoint()
