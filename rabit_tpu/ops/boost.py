@@ -51,19 +51,54 @@ def _pick_fc(n_feat: int, n_bins: int) -> int:
     return min(n_feat, max(1, 1792 // _bins_eff(n_bins)))
 
 
-def _accumulate_hist(xb_blk, L, out_ref, *, n_bins: int, n_feat: int, fc: int):
-    """out_ref[m, f*Beff+b] += sum_r L[r, m] * [xb_blk[r, f] == b]."""
-    be = _bins_eff(n_bins)
+def _encode_bf16(L):
+    """Hi/lo-bf16 split of the f32 gradient matrix (~2^-16-relative error).
+
+    The two halves share ONE matmul, stacked along M: the MXU pads M to a
+    full 128-row tile anyway, and m_pad <= 64 for depth <= 6, so two
+    separate matmuls each waste >= half the tile — packing them halves the
+    level's MXU passes (measured ~1.4x whole-round).  The result splits
+    back and sums in f32, bitwise identical to the two-matmul form."""
     lhi = L.astype(jnp.bfloat16)
     llo = (L - lhi.astype(jnp.float32)).astype(jnp.bfloat16)
-    # The hi and lo halves share ONE matmul, stacked along M: the MXU pads
-    # M to a full 128-row tile anyway, and m_pad <= 64 for depth <= 6, so
-    # two separate matmuls each waste >= half the tile — packing them
-    # halves the level's MXU passes (measured ~1.4x whole-round).  The
-    # result splits back and sums in f32, bitwise identical to the two-
-    # matmul form.
-    m = L.shape[1]
     l2 = jnp.concatenate([lhi, llo], axis=1)
+    m = L.shape[1]
+    decode = lambda acc2: acc2[:m] + acc2[m:]
+    return l2, jnp.bfloat16, jnp.float32, decode
+
+
+def _encode_i8(L):
+    """Two-plane int8 fixed-point split, running the MXU at int8 rate (2x
+    the bf16 issue rate on v5e-class chips): L is split against a
+    power-of-two scale into two int8 planes (14-bit fixed point, error
+    <= 2^-13 of the block max — a little tail precision traded for double
+    MXU throughput), stacked along M into ONE s8 x s8 -> s32 matmul."""
+    m = L.shape[1]
+    # scale = 2^(e+1) where e = floor(log2 max|L|), read straight off the
+    # f32 exponent field so X = L/scale lies in (-1, 1) exactly.
+    amax = jnp.max(jnp.abs(L))
+    ebits = lax.bitcast_convert_type(amax, jnp.int32) >> 23
+    scale = lax.bitcast_convert_type((ebits + 1) << 23, jnp.float32)
+    x = L * (1.0 / scale)
+    a = jnp.round(x * 64.0)                      # |a| <= 64
+    b = jnp.round((x - a * (1.0 / 64.0)) * 8192.0)  # residual < 2^-7 => |b| <= 64
+    l2 = jnp.concatenate([a, b], axis=1).astype(jnp.int8)
+
+    def decode(acc2):
+        # |acc| <= R * 64 = 2^16 — exact in int32 and in the f32 convert.
+        hi = acc2[:m].astype(jnp.float32)
+        lo = acc2[m:].astype(jnp.float32)
+        return (hi * (1.0 / 64.0) + lo * (1.0 / 8192.0)) * scale
+
+    return l2, jnp.int8, jnp.int32, decode
+
+
+def _accum(xb_blk, L, out_ref, *, n_bins: int, n_feat: int, fc: int, i8: bool):
+    """out_ref[m, f*Beff+b] += sum_r L[r, m] * [xb_blk[r, f] == b], via the
+    MXU: the encoded gradient planes are contracted against per-feature-
+    group bin-indicator matrices built in VMEM."""
+    be = _bins_eff(n_bins)
+    l2, onehot_dtype, acc_dtype, decode = (_encode_i8 if i8 else _encode_bf16)(L)
     r = xb_blk.shape[0]
     b_iota = lax.broadcasted_iota(jnp.int32, (r, be), 1)
     for gi in range(0, n_feat, fc):
@@ -71,9 +106,9 @@ def _accumulate_hist(xb_blk, L, out_ref, *, n_bins: int, n_feat: int, fc: int):
         onehot = jnp.concatenate(
             [(xb_blk[:, f : f + 1] == b_iota) for f in range(gi, gi + k)],
             axis=1,
-        ).astype(jnp.bfloat16)
-        acc2 = lax.dot_general(l2, onehot, _DN, preferred_element_type=jnp.float32)
-        out_ref[:, gi * be : (gi + k) * be] += acc2[:m] + acc2[m:]
+        ).astype(onehot_dtype)
+        acc2 = lax.dot_general(l2, onehot, _DN, preferred_element_type=acc_dtype)
+        out_ref[:, gi * be : (gi + k) * be] += decode(acc2)
 
 
 def _gradient_matrix(node, g, h, *, n_nodes: int, m_pad: int):
@@ -103,7 +138,7 @@ def _route(xb_blk, node, feat_row, thr_row, *, p_pad: int, n_feat: int):
 # -- level 0: histogram at the root ----------------------------------------
 
 
-def _level0_kernel(xb_ref, g_ref, h_ref, out_ref, *, n_bins, n_feat, fc):
+def _level0_kernel(xb_ref, g_ref, h_ref, out_ref, *, n_bins, n_feat, fc, i8):
     @pl.when(pl.program_id(0) == 0)
     def _init():
         out_ref[:] = jnp.zeros_like(out_ref)
@@ -111,7 +146,7 @@ def _level0_kernel(xb_ref, g_ref, h_ref, out_ref, *, n_bins, n_feat, fc):
     r = g_ref.shape[1]
     node = jnp.zeros((r, 1), jnp.int32)
     L = _gradient_matrix(node, g_ref[0], h_ref[0], n_nodes=1, m_pad=8)
-    _accumulate_hist(xb_ref[0], L, out_ref, n_bins=n_bins, n_feat=n_feat, fc=fc)
+    _accum(xb_ref[0], L, out_ref, n_bins=n_bins, n_feat=n_feat, fc=fc, i8=i8)
 
 
 # -- level d >= 1: route + histogram ---------------------------------------
@@ -119,7 +154,7 @@ def _level0_kernel(xb_ref, g_ref, h_ref, out_ref, *, n_bins, n_feat, fc):
 
 def _level_kernel(xb_ref, node_ref, g_ref, h_ref, feat_ref, thr_ref,
                   out_ref, node_out_ref, *,
-                  n_nodes, n_bins, n_feat, m_pad, p_pad, fc):
+                  n_nodes, n_bins, n_feat, m_pad, p_pad, fc, i8):
     @pl.when(pl.program_id(0) == 0)
     def _init():
         out_ref[:] = jnp.zeros_like(out_ref)
@@ -128,7 +163,7 @@ def _level_kernel(xb_ref, node_ref, g_ref, h_ref, feat_ref, thr_ref,
                   p_pad=p_pad, n_feat=n_feat)
     node_out_ref[0] = node
     L = _gradient_matrix(node, g_ref[0], h_ref[0], n_nodes=n_nodes, m_pad=m_pad)
-    _accumulate_hist(xb_ref[0], L, out_ref, n_bins=n_bins, n_feat=n_feat, fc=fc)
+    _accum(xb_ref[0], L, out_ref, n_bins=n_bins, n_feat=n_feat, fc=fc, i8=i8)
 
 
 # -- routing-only pass (leaf assignment without histogramming) -------------
@@ -192,14 +227,16 @@ def _leaf_kernel(xb_ref, node_ref, g_ref, h_ref, feat_ref, thr_ref,
 _blk = lambda R, k: pl.BlockSpec((1, R, k), lambda i: (i, 0, 0))
 
 
-@functools.partial(jax.jit, static_argnames=("n_bins", "interpret"))
-def hist_level0(xb3, g3, h3, *, n_bins: int, interpret: bool = False):
+@functools.partial(jax.jit, static_argnames=("n_bins", "interpret", "mxu_i8"))
+def hist_level0(xb3, g3, h3, *, n_bins: int, interpret: bool = False,
+                mxu_i8: bool = False):
     """Root histogram; [1, F, B, 2]."""
     nb, R, F = xb3.shape
     be = _bins_eff(n_bins)
     fc = _pick_fc(F, n_bins)
     out = pl.pallas_call(
-        functools.partial(_level0_kernel, n_bins=n_bins, n_feat=F, fc=fc),
+        functools.partial(_level0_kernel, n_bins=n_bins, n_feat=F, fc=fc,
+                          i8=mxu_i8),
         grid=(nb,),
         in_specs=[_blk(R, F), _blk(R, 1), _blk(R, 1)],
         out_specs=pl.BlockSpec((8, F * be), lambda i: (0, 0)),
@@ -210,9 +247,11 @@ def hist_level0(xb3, g3, h3, *, n_bins: int, interpret: bool = False):
     return jnp.stack([out[0:1], out[1:2]], axis=-1)
 
 
-@functools.partial(jax.jit, static_argnames=("depth", "n_bins", "interpret"))
+@functools.partial(
+    jax.jit, static_argnames=("depth", "n_bins", "interpret", "mxu_i8")
+)
 def hist_level(xb3, node3, g3, h3, feat, thr, *, depth: int, n_bins: int,
-               interpret: bool = False):
+               interpret: bool = False, mxu_i8: bool = False):
     """Route one level down and histogram; returns
     ([2**depth, F, B, 2], node3').  ``feat``/``thr`` are the level-(depth-1)
     split tables, shape [2**(depth-1)]."""
@@ -228,7 +267,7 @@ def hist_level(xb3, node3, g3, h3, feat, thr, *, depth: int, n_bins: int,
     out, node_out = pl.pallas_call(
         functools.partial(
             _level_kernel, n_nodes=n_nodes, n_bins=n_bins, n_feat=F,
-            m_pad=m_pad, p_pad=p_pad, fc=fc,
+            m_pad=m_pad, p_pad=p_pad, fc=fc, i8=mxu_i8,
         ),
         grid=(nb,),
         in_specs=[

@@ -2,7 +2,7 @@
 
 ``hist[node, f, b] = sum_i [node_i == node][xb_i[f] == b] * (g_i, h_i)``
 
-Three implementations of the same contract:
+Implementations of the same contract:
 
 * ``node_histograms_scatter`` — ``segment_sum`` (XLA scatter-add).  Exact
   f32, the portable reference; scatter serializes on TPU so it is the slow
@@ -16,7 +16,9 @@ Three implementations of the same contract:
   the indicator matrices are built in VMEM and never touch HBM, and the f32
   gradients are split hi/lo into two bfloat16 matmuls so the MXU runs at
   bf16 rate with ~f32 accuracy (error 2^-16-relative, vs 2^-8 for naive
-  bf16).
+  bf16).  ``mxu_i8=True`` switches the contraction to a two-plane int8
+  fixed-point split (s8 x s8 -> s32, 2x the bf16 issue rate on
+  v5e-class MXUs, error ~2^-13 of the block max).
 
 ``node_histograms`` dispatches: Pallas on TPU, scatter elsewhere (tests run
 on the virtual CPU mesh and want exact-f32 determinism).
@@ -108,7 +110,8 @@ def node_histograms_onehot(xb, g, h, node, n_nodes: int, n_bins: int,
 
 
 def _hist_kernel(xb_ref, node_ref, g_ref, h_ref, out_ref, *,
-                 n_nodes: int, n_bins: int, m_pad: int, n_feat: int, fc: int):
+                 n_nodes: int, n_bins: int, m_pad: int, n_feat: int, fc: int,
+                 i8: bool):
     from rabit_tpu.ops import boost
 
     @pl.when(pl.program_id(0) == 0)
@@ -117,15 +120,17 @@ def _hist_kernel(xb_ref, node_ref, g_ref, h_ref, out_ref, *,
 
     L = boost._gradient_matrix(node_ref[0], g_ref[0], h_ref[0],
                                n_nodes=n_nodes, m_pad=m_pad)
-    boost._accumulate_hist(xb_ref[0], L, out_ref,
-                           n_bins=n_bins, n_feat=n_feat, fc=fc)
+    boost._accum(xb_ref[0], L, out_ref,
+                 n_bins=n_bins, n_feat=n_feat, fc=fc, i8=i8)
 
 
 @functools.partial(
-    jax.jit, static_argnames=("n_nodes", "n_bins", "block_rows", "interpret")
+    jax.jit,
+    static_argnames=("n_nodes", "n_bins", "block_rows", "interpret", "mxu_i8"),
 )
 def node_histograms_pallas(xb, g, h, node, n_nodes: int, n_bins: int,
-                           block_rows: int = 1024, interpret: bool = False):
+                           block_rows: int = 1024, interpret: bool = False,
+                           mxu_i8: bool = False):
     """Pallas implementation; [n_nodes, F, B, 2].  Grid = row blocks: the
     whole (2*nodes, F*B) histogram stays resident in VMEM (1.8 MB at
     depth 6 / 28 features / 256 bins) while row blocks stream through; the
@@ -150,7 +155,7 @@ def node_histograms_pallas(xb, g, h, node, n_nodes: int, n_bins: int,
     out = pl.pallas_call(
         functools.partial(
             _hist_kernel, n_nodes=n_nodes, n_bins=n_bins, m_pad=m_pad,
-            n_feat=F, fc=fc,
+            n_feat=F, fc=fc, i8=mxu_i8,
         ),
         grid=(nb,),
         in_specs=[
@@ -209,12 +214,20 @@ def segment_sum_matmul(values, seg, num_segments: int, block_rows: int = 8192):
 
 
 def node_histograms(xb, g, h, node, n_nodes: int, n_bins: int,
-                    impl: str | None = None):
-    """Backend-appropriate histogram build; [n_nodes, F, B, 2]."""
+                    impl: str | None = None, mxu_i8: bool = False):
+    """Backend-appropriate histogram build; [n_nodes, F, B, 2].  With
+    ``mxu_i8`` the TPU default becomes the int8-rate Pallas kernel (an
+    explicit ``impl`` always wins)."""
     if impl is None:
-        impl = "pallas" if jax.default_backend() == "tpu" else "scatter"
+        if jax.default_backend() == "tpu":
+            impl = "pallas_i8" if mxu_i8 else "pallas"
+        else:
+            impl = "scatter"
     if impl == "pallas":
         return node_histograms_pallas(xb, g, h, node, n_nodes, n_bins)
+    if impl == "pallas_i8":
+        return node_histograms_pallas(xb, g, h, node, n_nodes, n_bins,
+                                      mxu_i8=True)
     if impl == "onehot":
         return node_histograms_onehot(xb, g, h, node, n_nodes, n_bins)
     if impl == "scatter":

@@ -1,11 +1,12 @@
 #!/usr/bin/env python
 """Histogram-kernel ablation on the bench workload shape (1M x 28 x 256).
 
-Times the three node_histograms implementations (pallas MXU contraction /
-onehot XLA matmul / scatter segment_sum — rabit_tpu/ops/hist.py) per tree
-level, plus the fused boost kernels' route+hist level step, so the
-committed numbers say WHERE the round time goes (round-2 verdict: "nobody
-can tell whether routing or the histogram contraction dominates").
+Times the node_histograms implementations (pallas MXU contraction and its
+int8-rate variant / onehot XLA matmul / scatter segment_sum —
+rabit_tpu/ops/hist.py) per tree level, plus the fused boost kernels'
+route+hist level step in both bf16 and int8 forms, so the committed
+numbers say WHERE the round time goes (round-2 verdict: "nobody can tell
+whether routing or the histogram contraction dominates").
 
 Run on the real TPU (fresh process, no conftest pinning):
     python tools/hist_ablation.py [--rows 1000000] [--json-out f.jsonl]
@@ -82,6 +83,8 @@ def main() -> int:
     }
     if plat == "tpu":
         impls["pallas"] = hist.node_histograms_pallas
+        impls["pallas_i8"] = functools.partial(
+            hist.node_histograms_pallas, mxu_i8=True)
     for d in (0, args.depth - 1):
         n_nodes = 1 << d
         node = jnp.asarray(rng.randint(0, n_nodes, size=args.rows), jnp.int32)
@@ -107,11 +110,13 @@ def main() -> int:
                 rng.randint(0, args.feats, size=1 << (d - 1)), jnp.int32)
             thr = jnp.asarray(
                 rng.randint(0, args.bins, size=1 << (d - 1)), jnp.int32)
-            f = jax.jit(functools.partial(
-                boost.hist_level, depth=d, n_bins=args.bins))
-            dt = timed(f, xb3, node3, g3, h3, feat, thr)
-            emit({"kernel": "fused_route+hist", "level": d,
-                  "n_nodes_out": 1 << d, "ms": round(dt * 1e3, 3)})
+            for i8 in (False, True):
+                f = jax.jit(functools.partial(
+                    boost.hist_level, depth=d, n_bins=args.bins, mxu_i8=i8))
+                dt = timed(f, xb3, node3, g3, h3, feat, thr)
+                emit({"kernel": "fused_route+hist" + ("_i8" if i8 else ""),
+                      "level": d, "n_nodes_out": 1 << d,
+                      "ms": round(dt * 1e3, 3)})
 
     if args.json_out:
         out = Path(args.json_out)
