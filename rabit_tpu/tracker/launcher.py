@@ -41,6 +41,9 @@ class LocalCluster:
         # time.time() at each observed worker death (recovery-latency
         # benchmarks diff these against worker-reported recovery stamps)
         self.death_times: list[float] = []
+        # how many scheduled preemptions were actually delivered (a target
+        # that already exited cleanly is left alone and not counted)
+        self.preempts_delivered = 0
 
     def _spawn(self, cmd: list[str], tracker: Tracker, i: int) -> subprocess.Popen:
         env = dict(os.environ)
@@ -53,18 +56,43 @@ class LocalCluster:
         )
         return subprocess.Popen(cmd, env=env)
 
-    def run(self, cmd: list[str], timeout: float = 300.0) -> int:
+    def run(
+        self,
+        cmd: list[str],
+        timeout: float = 300.0,
+        preempt: list[tuple[float, int]] | None = None,
+    ) -> int:
         """Run ``cmd`` x num_workers under a fresh tracker.  Returns 0 when
         every worker exited cleanly; raises on restart-budget exhaustion or
-        timeout."""
+        timeout.
+
+        ``preempt`` schedules abrupt external deaths: ``[(delay_s, rank),
+        ...]`` SIGKILLs that worker ``delay_s`` seconds after launch,
+        wherever it happens to be — mid-collective, mid-bootstrap, inside a
+        checkpoint.  This is the TPU-VM-preemption failure shape (BASELINE
+        north star: "checkpoint-recover under induced preemption"), the
+        complement of the mock engine's deterministic kill points.  The
+        killed worker is restarted from the normal budget like any other
+        death."""
         tracker = Tracker(self.num_workers, quiet=self.quiet).start()
         self.messages = tracker.messages
         procs = [self._spawn(cmd, tracker, i) for i in range(self.num_workers)]
-        deadline = time.monotonic() + timeout
+        start = time.monotonic()
+        deadline = start + timeout
+        pending = sorted(preempt or [], key=lambda p: p[0], reverse=True)
         try:
             while True:
                 if time.monotonic() > deadline:
                     raise TimeoutError(f"cluster did not finish within {timeout}s")
+                while pending and time.monotonic() - start >= pending[-1][0]:
+                    _, idx = pending.pop()
+                    proc = procs[idx]
+                    if proc is not None and proc.poll() is None:
+                        proc.kill()
+                        self.preempts_delivered += 1
+                        if not self.quiet:
+                            print(f"[launcher] preempted worker {idx} "
+                                  f"(SIGKILL)", flush=True)
                 alive = 0
                 for i, proc in enumerate(procs):
                     if proc is None:

@@ -1,0 +1,58 @@
+"""Induced preemption: abrupt external SIGKILL at an arbitrary instant.
+
+The mock engine kills workers at DETERMINISTIC protocol points
+(rank/version/seqno); a real TPU-VM preemption lands wherever it lands —
+mid-collective, inside the two-phase checkpoint, even during another
+worker's recovery.  These tests deliver timed SIGKILLs from outside the
+process (LocalCluster ``preempt=``) and require the self-verifying
+workload (tests/workers/recover_worker.py, the reference's
+model_recover shape) to still complete with every element checked.
+
+This is the BASELINE north-star failure shape ("checkpoint-recover under
+induced preemption") and the complement of the deterministic matrix in
+test_recover.py.
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+from rabit_tpu.tracker.launcher import LocalCluster
+
+WORKER = str(Path(__file__).parent / "workers" / "recover_worker.py")
+
+# Big enough that the job is still mid-iteration when the kills land on
+# this (single-core, oversubscribed) container; small enough to finish
+# promptly once recovery is done.
+ARGS = ["rabit_engine=robust", "ndata=50000", "niter=6"]
+
+
+def run_with_preempts(preempts, nworkers=4, timeout=240.0):
+    cmd = [sys.executable, WORKER, *ARGS]
+    cluster = LocalCluster(nworkers, max_restarts=10, quiet=True)
+    rc = cluster.run(cmd, timeout=timeout, preempt=preempts)
+    assert rc == 0
+    assert all(r == 0 for r in cluster.returncodes)
+    return cluster
+
+
+def test_preempt_single():
+    """One worker SIGKILLed ~mid-run recovers and the job verifies."""
+    cluster = run_with_preempts([(1.5, 1)])
+    assert cluster.preempts_delivered == 1
+    assert cluster.restarts[1] >= 1
+
+
+def test_preempt_two_at_once():
+    """Two workers preempted at the same instant (multi-death)."""
+    cluster = run_with_preempts([(1.5, 1), (1.5, 2)])
+    assert cluster.preempts_delivered == 2
+
+
+def test_preempt_repeated_same_rank():
+    """The same worker preempted twice — the second kill can land during
+    or shortly after its own recovery (die-hard, externally induced)."""
+    cluster = run_with_preempts([(1.0, 2), (3.0, 2)])
+    assert cluster.preempts_delivered == 2
+    assert cluster.restarts[2] >= 2

@@ -27,7 +27,7 @@ from rabit_tpu.tracker.launcher import LocalCluster  # noqa: E402
 WORKER = str(REPO / "tests" / "workers" / "recover_worker.py")
 
 
-def run_once(world: int, extra: list[str], timeout: float = 180.0):
+def run_once(world: int, extra: list[str], timeout: float | None = None):
     """Returns (wall_s, protocol_latency_s|None).  Protocol latency = from
     the launcher observing the death to the restarted worker's state being
     recovered from peers (the recovered_at stamp recover_worker prints) —
@@ -37,6 +37,11 @@ def run_once(world: int, extra: list[str], timeout: float = 180.0):
            "niter=3", *extra]
     cluster = LocalCluster(world, max_restarts=5, quiet=True)
     t0 = time.perf_counter()
+    if timeout is None:
+        # Scale with world: on an oversubscribed host, wall time grows
+        # ~linearly in worker count (world 32 on this single-core container
+        # already takes ~90 s — a flat 180 s left <2x headroom).
+        timeout = max(180.0, world * 12.0)
     rc = cluster.run(cmd, timeout=timeout)
     dt = time.perf_counter() - t0
     if rc != 0 or any(r != 0 for r in cluster.returncodes):
