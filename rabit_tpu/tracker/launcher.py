@@ -85,14 +85,21 @@ class LocalCluster:
                 if time.monotonic() > deadline:
                     raise TimeoutError(f"cluster did not finish within {timeout}s")
                 while pending and time.monotonic() - start >= pending[-1][0]:
-                    _, idx = pending.pop()
+                    _, idx = pending[-1]
                     proc = procs[idx]
-                    if proc is not None and proc.poll() is None:
-                        proc.kill()
-                        self.preempts_delivered += 1
-                        if not self.quiet:
-                            print(f"[launcher] preempted worker {idx} "
-                                  f"(SIGKILL)", flush=True)
+                    if proc is not None and proc.poll() is not None:
+                        # Target died but hasn't been reaped/restarted yet:
+                        # keep the entry queued so the kill lands on the
+                        # restarted life instead of being silently dropped.
+                        break
+                    pending.pop()
+                    if proc is None:
+                        continue  # finished cleanly — nothing to preempt
+                    proc.kill()
+                    self.preempts_delivered += 1
+                    if not self.quiet:
+                        print(f"[launcher] preempted worker {idx} "
+                              f"(SIGKILL)", flush=True)
                 alive = 0
                 for i, proc in enumerate(procs):
                     if proc is None:

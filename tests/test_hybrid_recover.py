@@ -28,20 +28,20 @@ WORKER = str(Path(__file__).parent / "workers" / "gbdt_hybrid_worker.py")
 
 
 def run_cluster(nworkers, worker_args, out: Path, max_restarts=10,
-                timeout=420.0):
+                timeout=420.0, preempt=None):
     cmd = [sys.executable, WORKER, "rabit_engine=mock", f"out={out}",
            *worker_args]
     cluster = LocalCluster(nworkers, max_restarts=max_restarts, quiet=True)
-    assert cluster.run(cmd, timeout=timeout) == 0
+    assert cluster.run(cmd, timeout=timeout, preempt=preempt) == 0
     assert all(rc == 0 for rc in cluster.returncodes)
-    return np.load(out.with_suffix(".npy"))
+    return cluster, np.load(out.with_suffix(".npy"))
 
 
 @pytest.fixture(scope="module")
 def clean_forest(tmp_path_factory):
     """The no-failure reference forest (also the no-kill sanity run)."""
     out = tmp_path_factory.mktemp("hybrid") / "clean"
-    return run_cluster(4, ["ntrees=4"], out, max_restarts=0)
+    return run_cluster(4, ["ntrees=4"], out, max_restarts=0)[1]
 
 
 def test_hybrid_no_failure(clean_forest):
@@ -52,7 +52,7 @@ def test_hybrid_kill_mid_round(clean_forest, tmp_path):
     """Rank 1 dies INSIDE the jitted step (level-1 histogram callback of the
     second tree); it reloads forest + its replicated margin, rebuilds device
     arrays, and the final forest is byte-identical to the clean run."""
-    got = run_cluster(4, ["ntrees=4", "mock=1,1,1,0"], tmp_path / "k1")
+    got = run_cluster(4, ["ntrees=4", "mock=1,1,1,0"], tmp_path / "k1")[1]
     assert np.array_equal(got, clean_forest)
 
 
@@ -60,19 +60,32 @@ def test_hybrid_kill_at_leaf_and_die_hard(clean_forest, tmp_path):
     """A leaf-allreduce death plus a second death on the restarted life
     (die-hard), still byte-identical."""
     got = run_cluster(4, ["ntrees=4", "mock=2,0,3,0;2,2,0,1"],
-                      tmp_path / "k2")
+                      tmp_path / "k2")[1]
     assert np.array_equal(got, clean_forest)
 
 
 def test_hybrid_kill_at_checkpoint_commit(clean_forest, tmp_path):
     """Death in the checkpoint commit window (post-barrier, pre-release) —
     the split-commit path — with device-state rebuild."""
-    got = run_cluster(4, ["ntrees=4", "mock=3,2,-3,0"], tmp_path / "k3")
+    got = run_cluster(4, ["ntrees=4", "mock=3,2,-3,0"], tmp_path / "k3")[1]
     assert np.array_equal(got, clean_forest)
 
 
 def test_hybrid_multi_death_same_step(clean_forest, tmp_path):
     """Two workers die at the same histogram allreduce (die_same)."""
     got = run_cluster(4, ["ntrees=4", "mock=0,1,0,0;2,1,0,0"],
-                      tmp_path / "k4")
+                      tmp_path / "k4")[1]
+    assert np.array_equal(got, clean_forest)
+
+
+def test_hybrid_external_preemption(clean_forest, tmp_path):
+    """An external SIGKILL at an arbitrary instant — during jit compile, a
+    jitted step, a callback, or a checkpoint, wherever it lands — must
+    still end in a forest byte-identical to the clean run (replay serves
+    the already-combined histograms deterministically regardless of WHERE
+    the death happened).  pause=4 per tree lower-bounds the run at 16 s on
+    any machine speed, so both kills always land mid-run."""
+    cluster, got = run_cluster(4, ["ntrees=4", "pause=4"], tmp_path / "p1",
+                               preempt=[(6.0, 1), (14.0, 3)])
+    assert cluster.preempts_delivered == 2
     assert np.array_equal(got, clean_forest)

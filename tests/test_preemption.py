@@ -22,10 +22,11 @@ from rabit_tpu.tracker.launcher import LocalCluster
 
 WORKER = str(Path(__file__).parent / "workers" / "recover_worker.py")
 
-# Big enough that the job is still mid-iteration when the kills land on
-# this (single-core, oversubscribed) container; small enough to finish
-# promptly once recovery is done.
-ARGS = ["rabit_engine=robust", "ndata=50000", "niter=6"]
+# sleep=0.75 x 6 iterations lower-bounds the run at 4.5 s on ANY machine
+# speed (CI runners are much faster than this single-core container), so
+# the timed kills below always land mid-work; ndata keeps the collectives
+# non-trivial.
+ARGS = ["rabit_engine=robust", "ndata=50000", "niter=6", "sleep=0.75"]
 
 
 def run_with_preempts(preempts, nworkers=4, timeout=240.0):

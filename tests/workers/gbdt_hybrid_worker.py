@@ -23,6 +23,7 @@ nobody — a real preemption kills the process outright too.
 
 import os
 import sys
+import time
 from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parents[2]))
@@ -72,6 +73,10 @@ def pack_forest(forest) -> np.ndarray:
 def main() -> int:
     n_trees = int(getarg("ntrees", "4"))
     out_path = getarg("out", "")
+    # pause=S sleeps S seconds per tree: a machine-independent minimum run
+    # duration so timed external preemptions land mid-training on hosts of
+    # any speed (tests/test_hybrid_recover.py::test_hybrid_external_preemption).
+    pause = float(getarg("pause", "0"))
     rt.init()
     rank, world = rt.get_rank(), rt.get_world_size()
 
@@ -124,6 +129,8 @@ def main() -> int:
     check(int(state.round) == version, f"round {int(state.round)} vs {version}")
 
     for t in range(version, n_trees):
+        if pause:
+            time.sleep(pause)
         state = step(state, xb, yj)
         rt.checkpoint(
             tuple(np.asarray(a) for a in state.forest),  # global: the forest

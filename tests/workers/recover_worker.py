@@ -16,6 +16,10 @@ Worker args (k=v on the command line, all also forwarded to the engine):
     lazy=1         use lazy_checkpoint
     preload_op=1   run a keyed broadcast before load_checkpoint
                    (exercises the bootstrap cache)
+    sleep=S        sleep S seconds per iteration — gives the run a
+                   machine-independent minimum duration so timed external
+                   preemptions (tests/test_preemption.py) reliably land
+                   mid-work on hosts of any speed
 """
 
 import os
@@ -46,6 +50,7 @@ def check(cond: bool, what: str) -> None:
 def main() -> int:
     ndata = int(getarg("ndata", "100"))
     niter = int(getarg("niter", "3"))
+    pause = float(getarg("sleep", "0"))
     use_local = getarg("local", "0") == "1"
     use_lazy = getarg("lazy", "0") == "1"
     preload_op = getarg("preload_op", "0") == "1"
@@ -81,6 +86,8 @@ def main() -> int:
         )
 
     for it in range(version, niter):
+        if pause:
+            time.sleep(pause)
         # MAX: data[i] = rank + i + it  ->  world-1 + i + it
         a = (np.arange(ndata) + rank + it).astype(np.float32)
         out = rt.allreduce(a, rt.MAX)
