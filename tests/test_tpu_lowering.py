@@ -1,0 +1,83 @@
+"""AOT TPU lowering gate — catches Mosaic rejections without a TPU.
+
+The Pallas interpreter (how the CPU suite checks kernel NUMERICS) shares
+no code with the Mosaic TPU compiler, so a kernel can pass every
+interpret-mode test and still fail to lower for real hardware — exactly
+what happened to the int8 encoder's scalar exponent bitcast (tpu.bitcast
+requires vectors).  ``jax.export`` runs the full TPU lowering pipeline,
+Mosaic included, on any host, so this file gates every Pallas kernel and
+the whole fused round for both MXU modes in plain CPU CI.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from rabit_tpu.models import gbdt
+from rabit_tpu.ops import boost, hist
+
+NB, R, F, B = 2, 1024, 28, 256
+I8 = (False, True)
+
+
+def export_tpu(fn, *args):
+    return jax.export.export(jax.jit(fn), platforms=["tpu"])(*args)
+
+
+@pytest.mark.parametrize("i8", I8)
+def test_hist_kernel_lowers(i8):
+    n = NB * R
+    xb = jnp.zeros((n, F), jnp.int32)
+    g = h = jnp.zeros(n, jnp.float32)
+    node = jnp.zeros(n, jnp.int32)
+    export_tpu(
+        functools.partial(hist.node_histograms_pallas, n_nodes=8, n_bins=B,
+                          mxu_i8=i8),
+        xb, g, h, node,
+    )
+
+
+@pytest.mark.parametrize("i8", I8)
+def test_fused_level_kernels_lower(i8):
+    xb3 = jnp.zeros((NB, R, F), jnp.int32)
+    g3 = h3 = jnp.zeros((NB, R, 1), jnp.float32)
+    node3 = jnp.zeros((NB, R, 1), jnp.int32)
+    export_tpu(
+        functools.partial(boost.hist_level0, n_bins=B, mxu_i8=i8), xb3, g3, h3
+    )
+    for d in (1, 5):
+        tab = jnp.zeros(1 << (d - 1), jnp.int32)
+        export_tpu(
+            functools.partial(boost.hist_level, depth=d, n_bins=B, mxu_i8=i8),
+            xb3, node3, g3, h3, tab, tab,
+        )
+
+
+def test_route_and_leaf_kernels_lower():
+    xb3 = jnp.zeros((NB, R, F), jnp.int32)
+    g3 = h3 = jnp.zeros((NB, R, 1), jnp.float32)
+    node3 = jnp.zeros((NB, R, 1), jnp.int32)
+    tab = jnp.zeros(1 << 5, jnp.int32)
+    export_tpu(
+        functools.partial(boost.route_level, depth=6), xb3, node3, tab, tab
+    )
+    export_tpu(
+        functools.partial(boost.leaf_fit, depth=6), xb3, node3, g3, h3, tab, tab
+    )
+
+
+@pytest.mark.parametrize("i8", I8)
+def test_full_fused_round_lowers(i8):
+    """The exact program bench.py jits on the chip, both MXU modes."""
+    n = NB * R
+    cfg = gbdt.GBDTConfig(n_features=F, n_trees=2, depth=6, n_bins=B,
+                          mxu_i8=i8)
+    xb3 = jnp.zeros((NB, R, F), jnp.int32)
+    y = jnp.zeros(n, jnp.float32)
+    state = gbdt.init_state(cfg, n)
+    export_tpu(functools.partial(gbdt.train_round_fused, cfg=cfg),
+               state, xb3, y)
