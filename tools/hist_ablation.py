@@ -118,6 +118,23 @@ def main() -> int:
                       "level": d, "n_nodes_out": 1 << d,
                       "ms": round(dt * 1e3, 3)})
 
+        # Whole fused round, both MXU modes — ties the per-kernel numbers
+        # to the headline rounds/s metric in one provenance-consistent run
+        # (same plat gate as above: reuses xb3).
+        from rabit_tpu.models import gbdt
+
+        y = jnp.asarray(rng.randint(0, 2, size=args.rows), jnp.float32)
+        for i8 in (False, True):
+            cfg = gbdt.GBDTConfig(n_features=args.feats, n_trees=8,
+                                  depth=args.depth, n_bins=args.bins,
+                                  mxu_i8=i8)
+            step = jax.jit(functools.partial(gbdt.train_round_fused, cfg=cfg))
+            state = gbdt.init_state(cfg, args.rows)
+            dt = timed(step, state, xb3, y, n=4)
+            emit({"kernel": "train_round_fused" + ("_i8" if i8 else ""),
+                  "depth": args.depth, "ms": round(dt * 1e3, 3),
+                  "rounds_per_sec": round(1.0 / dt, 2)})
+
     if args.json_out:
         out = Path(args.json_out)
         out.parent.mkdir(parents=True, exist_ok=True)
