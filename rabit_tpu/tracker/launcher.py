@@ -145,6 +145,11 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--max-restarts", type=int, default=0)
     ap.add_argument("--timeout", type=float, default=300.0)
     ap.add_argument("--quiet", action="store_true")
+    ap.add_argument(
+        "--preempt", action="append", default=[], metavar="DELAY:RANK",
+        help="SIGKILL worker RANK DELAY seconds after launch, wherever it "
+             "happens to be (repeatable; induced-preemption testing)",
+    )
     ap.add_argument("cmd", nargs=argparse.REMAINDER)
     args = ap.parse_args(argv)
     cmd = args.cmd
@@ -152,8 +157,18 @@ def main(argv: list[str] | None = None) -> int:
         cmd = cmd[1:]
     if not cmd:
         ap.error("worker command required after --")
+    preempt = []
+    for s in args.preempt:
+        try:
+            delay, rank = s.split(":")
+            preempt.append((float(delay), int(rank)))
+        except ValueError:
+            ap.error(f"--preempt wants DELAY:RANK pairs, got {s!r}")
+        if not 0 <= preempt[-1][1] < args.num_workers:
+            ap.error(f"--preempt rank {preempt[-1][1]} outside "
+                     f"0..{args.num_workers - 1}")
     cluster = LocalCluster(args.num_workers, args.max_restarts, quiet=args.quiet)
-    return cluster.run(cmd, timeout=args.timeout)
+    return cluster.run(cmd, timeout=args.timeout, preempt=preempt)
 
 
 if __name__ == "__main__":

@@ -4,9 +4,11 @@
 Times the node_histograms implementations (pallas MXU contraction and its
 int8-rate variant / onehot XLA matmul / scatter segment_sum —
 rabit_tpu/ops/hist.py) per tree level, plus the fused boost kernels'
-route+hist level step in both bf16 and int8 forms, so the committed
-numbers say WHERE the round time goes (round-2 verdict: "nobody can tell
-whether routing or the histogram contraction dominates").
+route+hist level step and the WHOLE fused boosting round (records
+train_round_fused{,_i8} with a rounds_per_sec field), each in both bf16
+and int8 MXU forms, so the committed numbers say WHERE the round time
+goes (round-2 verdict: "nobody can tell whether routing or the histogram
+contraction dominates") and tie the kernel split to the headline metric.
 
 Run on the real TPU (fresh process, no conftest pinning):
     python tools/hist_ablation.py [--rows 1000000] [--json-out f.jsonl]
