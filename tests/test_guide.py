@@ -16,9 +16,9 @@ REPO = Path(__file__).resolve().parents[1]
 GUIDE = REPO / "guide"
 
 
-def run_solo(cmd: list[str]) -> str:
+def run_solo(cmd: list[str], timeout: float = 60) -> str:
     proc = subprocess.run(
-        cmd, capture_output=True, text=True, timeout=60, cwd=REPO
+        cmd, capture_output=True, text=True, timeout=timeout, cwd=REPO
     )
     assert proc.returncode == 0, proc.stderr
     return proc.stdout
@@ -59,6 +59,35 @@ def test_lazy_allreduce_py_mock_failure():
     )
     assert rc == 0
     assert cluster.restarts[0] == 1
+
+
+def test_hybrid_gbdt_py_solo():
+    out = run_solo([sys.executable, str(GUIDE / "hybrid_gbdt.py")],
+                   timeout=200)
+    assert "hybrid gbdt: 3 trees" in out
+
+
+def test_hybrid_gbdt_py_mock_failure():
+    """The hybrid-deployment demo under a mid-training kill: worker 1 dies
+    inside the jitted step's engine callback, restarts, recovers forest +
+    margin from peers, and both workers report the same accuracy
+    (asserted via the tracker message log, which the demo reports into)."""
+    cluster = LocalCluster(2, max_restarts=3, quiet=True)
+    rc = cluster.run(
+        [
+            sys.executable,
+            str(GUIDE / "hybrid_gbdt.py"),
+            "rabit_engine=mock",
+            "mock=1,1,1,0",
+        ],
+        timeout=300,
+    )
+    assert rc == 0
+    assert cluster.restarts[1] == 1
+    reports = sorted(m for m in cluster.messages if "hybrid gbdt" in m)
+    assert len(reports) == 2, cluster.messages
+    acc = [m.split("train-acc ")[1] for m in reports]
+    assert acc[0] == acc[1], reports
 
 
 # --- C++ examples ----------------------------------------------------------
