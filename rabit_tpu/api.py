@@ -29,6 +29,30 @@ import time
 from rabit_tpu.profile import GLOBAL_STATS, CollectiveStats, OpStats
 
 _engine: Engine | None = None
+# Durable-spill state (rabit_checkpoint_dir): the store, and the user-visible
+# version base when this job resumed a previous job's disk checkpoints.  The
+# base also travels inside every wrapped global blob (_wrap/_unwrap), so a
+# worker restarted mid-job recovers it from the peer-served blob rather than
+# from process memory.
+_ckpt_store = None
+_ckpt_base = 0
+
+_WRAP_TAG = "__rabit_tpu_ckpt1__"
+
+
+def _wrap(base: int, gblob: bytes) -> bytes:
+    return pickle.dumps((_WRAP_TAG, base, gblob), protocol=pickle.HIGHEST_PROTOCOL)
+
+
+def _unwrap(blob: bytes) -> tuple[int, bytes]:
+    """Returns (base, inner_blob); plain blobs (store off) pass through."""
+    try:
+        obj = pickle.loads(blob)
+    except Exception:  # noqa: BLE001 — not a pickle we wrote
+        return 0, blob
+    if isinstance(obj, tuple) and len(obj) == 3 and obj[0] == _WRAP_TAG:
+        return int(obj[1]), obj[2]
+    return 0, blob
 
 
 def collective_stats() -> CollectiveStats:
@@ -82,14 +106,25 @@ def init(args: list[str] | None = None, **overrides: Any) -> None:
     cfg = Config(args, {k: str(v) for k, v in overrides.items()})
     _engine = create_engine(cfg)
     _engine.init()
+    global _ckpt_store, _ckpt_base
+    _ckpt_base = 0
+    ckpt_dir = cfg.get("rabit_checkpoint_dir", "") or ""
+    if ckpt_dir and ckpt_dir != "NULL":
+        from rabit_tpu.store import CheckpointStore
+
+        _ckpt_store = CheckpointStore(ckpt_dir, _engine.get_rank())
+    else:
+        _ckpt_store = None
 
 
 def finalize() -> None:
     """Shut down the engine (reference: RabitFinalize)."""
-    global _engine
+    global _engine, _ckpt_store, _ckpt_base
     if _engine is not None:
         _engine.shutdown()
         _engine = None
+    _ckpt_store = None
+    _ckpt_base = 0
 
 
 def get_rank() -> int:
@@ -181,11 +216,72 @@ def allgather(data: np.ndarray) -> np.ndarray:
     return np.asarray(out).reshape((engine.get_world_size(),) + data.shape)
 
 
+def _disk_resume():
+    """Fresh-cluster disk resume (store configured, engine version 0).
+
+    Every first-life worker runs this IDENTICAL deterministic collective
+    sequence (decisions depend only on collective results, which agree on
+    all ranks), so the robust engine's replay contract holds; a worker
+    restarted before the first checkpoint re-enters this same path, and
+    one restarted after sees engine version > 0 and never comes here.
+
+    Returns (base_version, gblob, lblob) — (0, None, None) when there is
+    nothing on disk anywhere."""
+    engine = _get_engine()
+    mine = np.array([_ckpt_store.latest()], np.int64)
+    vmax = int(engine.allreduce(mine, MAX, cache_key="rabit_tpu.store::vmax")[0])
+    if vmax <= 0:
+        return 0, None, None
+    have = int(_ckpt_store.has(vmax))
+    all_have = int(
+        engine.allreduce(np.array([have], np.int64), MIN,
+                         cache_key="rabit_tpu.store::have")[0]
+    )
+    if all_have:
+        return vmax, _ckpt_store.load_global(vmax), _ckpt_store.load_local(vmax)
+    # Someone's disk copy is missing/stale: the lowest-ranked holder serves
+    # the (rank-identical) global blob over a broadcast.  Rank-specific
+    # local models cannot be served this way; a rank without its own file
+    # resumes with local_model=None.
+    world = engine.get_world_size()
+    root = int(
+        engine.allreduce(
+            np.array([engine.get_rank() if have else world], np.int64), MIN,
+            cache_key="rabit_tpu.store::root")[0]
+    )
+    gblob = engine.broadcast(
+        _ckpt_store.load_global(vmax) if engine.get_rank() == root else None,
+        root, cache_key="rabit_tpu.store::blob",
+    )
+    lblob = _ckpt_store.load_local(vmax) if have else None
+    return vmax, bytes(gblob), lblob
+
+
 def load_checkpoint(with_local: bool = False):
     """Load the latest checkpoint.  Returns ``(version, global_model)`` or
     ``(version, global_model, local_model)``; version 0 means nothing has
-    been checkpointed yet."""
+    been checkpointed yet.  With ``rabit_checkpoint_dir`` configured, a
+    fresh cluster first agrees on and resumes from the newest disk
+    checkpoint (whole-job preemption durability)."""
+    global _ckpt_base
     version, gblob, lblob = _get_engine().load_checkpoint()
+    if _ckpt_store is not None:
+        if version == 0:
+            vmax, dgblob, dlblob = _disk_resume()
+            if vmax > 0:
+                # Resuming a PREVIOUS job: the file's version is the new
+                # base; the wrapper inside carries the old job's base and
+                # is discarded.
+                _ckpt_base = vmax
+                _, gblob = _unwrap(dgblob)
+                lblob = dlblob
+                version = vmax
+        else:
+            # Peer-served blob from the CURRENT job: its wrapper carries
+            # this job's base (authoritative for a restarted worker, whose
+            # process state starts empty).
+            _ckpt_base, gblob = _unwrap(gblob)
+            version = _ckpt_base + version
     gmodel = pickle.loads(gblob) if version > 0 and gblob is not None else None
     if with_local:
         lmodel = pickle.loads(lblob) if version > 0 and lblob is not None else None
@@ -196,10 +292,20 @@ def load_checkpoint(with_local: bool = False):
 def checkpoint(global_model: Any, local_model: Any = None) -> None:
     """Commit an iteration: pickle and store the models, bump the version.
     ``local_model`` (rank-specific state) costs ring replication; prefer
-    ``global_model`` (reference notes, python/rabit.py:320-351)."""
+    ``global_model`` (reference notes, python/rabit.py:320-351).  With
+    ``rabit_checkpoint_dir`` configured, the committed blobs are also
+    spilled to disk (whole-job preemption durability)."""
     gblob = pickle.dumps(global_model, protocol=pickle.HIGHEST_PROTOCOL)
     lblob = None if local_model is None else pickle.dumps(local_model, protocol=pickle.HIGHEST_PROTOCOL)
-    _get_engine().checkpoint(gblob, lblob)
+    engine = _get_engine()
+    if _ckpt_store is None:
+        engine.checkpoint(gblob, lblob)
+        return
+    wrapped = _wrap(_ckpt_base, gblob)
+    engine.checkpoint(wrapped, lblob)
+    # Persist AFTER the commit barrier: live ranks' disk versions can then
+    # skew by at most one, which the store's keep-2 retention covers.
+    _ckpt_store.save(_ckpt_base + engine.version_number(), wrapped, lblob)
 
 
 def lazy_checkpoint(global_model: Any) -> None:
@@ -208,11 +314,20 @@ def lazy_checkpoint(global_model: Any) -> None:
     ``global_model`` must stay unchanged until the NEXT checkpoint call
     RETURNS — recovery during that next call's pre-commit consensus can
     still serve this version through this call's callback.  Rebind a fresh
-    object per iteration rather than mutating in place."""
+    object per iteration rather than mutating in place.
+
+    With ``rabit_checkpoint_dir`` configured this degrades to the eager
+    path: disk durability requires the bytes at commit time."""
+    if _ckpt_store is not None:
+        checkpoint(global_model)
+        return
     _get_engine().lazy_checkpoint(
         lambda: pickle.dumps(global_model, protocol=pickle.HIGHEST_PROTOCOL)
     )
 
 
 def version_number() -> int:
-    return _get_engine().version_number()
+    """Checkpoint count.  When this job resumed disk checkpoints from a
+    previous job, the resumed base is included — user code always sees one
+    monotonically growing version line."""
+    return _ckpt_base + _get_engine().version_number()

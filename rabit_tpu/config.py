@@ -61,6 +61,10 @@ DEFAULTS: dict[str, str] = {
     # Comm::SetDefaultStallSec), and a value here would be serialized into
     # RabitInit argv and override that.
     "rabit_bootstrap_cache": "0",
+    # Durable checkpoint spill: when set, every committed checkpoint is
+    # also written here and a FRESH cluster resumes from the newest disk
+    # version (whole-job preemption durability; rabit_tpu/store.py).
+    "rabit_checkpoint_dir": "",
     "rabit_debug": "0",
     "rabit_enable_tcp_no_delay": "0",
 }

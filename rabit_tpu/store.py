@@ -1,0 +1,104 @@
+"""Durable checkpoint spill — surviving WHOLE-JOB preemption.
+
+The reference keeps checkpoints in memory only (doc/guide.md:185: a
+rejoiner pulls state from surviving peers), which covers single-worker
+deaths but loses everything when ALL workers die at once — exactly what a
+TPU-slice preemption does.  With ``rabit_checkpoint_dir`` set, every
+committed checkpoint is also written to disk (atomic rename + directory
+fsync, last two versions retained), and a FRESH cluster (engine consensus
+version 0) agrees on the newest version every rank can serve and resumes
+from it — including serving the global blob over a broadcast to ranks
+whose disk copy is missing or stale.
+
+This sits entirely ABOVE the engine seam (rabit_tpu.api), so it works
+with every backend unchanged.  The resume base version travels INSIDE the
+wrapped global blob, so a worker restarted later in the resumed job
+recovers the base from the peer-served blob, not from process memory.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from pathlib import Path
+
+_GLOBAL_RE = re.compile(r"^global_r(\d+)_v(\d+)\.bin$")
+_KEEP = 2  # two-phase commit skews live ranks by at most one version
+
+
+class CheckpointStore:
+    def __init__(self, directory: str, rank: int):
+        self.dir = Path(directory)
+        self.rank = rank
+        self.dir.mkdir(parents=True, exist_ok=True)
+        # One directory scan at startup seeds the version list (and sweeps
+        # tmp leftovers of crashed saves); after that, save() maintains it
+        # in memory so the per-checkpoint hot path never lists the shared
+        # directory — O(world^2) dirent reads per round on network
+        # filesystems otherwise.
+        self._versions: list[int] = []
+        for p in self.dir.iterdir():
+            if p.suffix == ".tmp" and f"_r{rank}_" in p.name:
+                p.unlink(missing_ok=True)
+            m = _GLOBAL_RE.match(p.name)
+            if m and int(m.group(1)) == rank:
+                self._versions.append(int(m.group(2)))
+        self._versions.sort()
+
+    # -- paths --------------------------------------------------------------
+
+    def _gpath(self, version: int) -> Path:
+        return self.dir / f"global_r{self.rank}_v{version}.bin"
+
+    def _lpath(self, version: int) -> Path:
+        return self.dir / f"local_r{self.rank}_v{version}.bin"
+
+    # -- writes -------------------------------------------------------------
+
+    def save(self, version: int, gblob: bytes, lblob: bytes | None) -> None:
+        """Persist one committed checkpoint atomically; prune old versions."""
+        self._write(self._gpath(version), gblob)
+        if lblob is not None:
+            self._write(self._lpath(version), lblob)
+        if version not in self._versions:
+            self._versions.append(version)
+            self._versions.sort()
+        while len(self._versions) > _KEEP:
+            v = self._versions.pop(0)
+            self._gpath(v).unlink(missing_ok=True)
+            self._lpath(v).unlink(missing_ok=True)
+
+    def _write(self, path: Path, blob: bytes) -> None:
+        tmp = path.with_suffix(".tmp")
+        with open(tmp, "wb") as f:
+            f.write(blob)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)  # atomic: readers see old or new, never torn
+        # The rename itself must survive a host crash too — fsync the
+        # directory entry, or the "durable" newest version can vanish on
+        # power loss while the prune of the older one persisted.
+        dfd = os.open(self.dir, os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+
+    # -- reads --------------------------------------------------------------
+
+    def versions(self) -> list[int]:
+        """This rank's persisted versions, ascending."""
+        return list(self._versions)
+
+    def latest(self) -> int:
+        return self._versions[-1] if self._versions else 0
+
+    def has(self, version: int) -> bool:
+        return version > 0 and self._gpath(version).exists()
+
+    def load_global(self, version: int) -> bytes:
+        return self._gpath(version).read_bytes()
+
+    def load_local(self, version: int) -> bytes | None:
+        p = self._lpath(version)
+        return p.read_bytes() if p.exists() else None

@@ -20,6 +20,9 @@ Worker args (k=v on the command line, all also forwarded to the engine):
                    machine-independent minimum duration so timed external
                    preemptions (tests/test_preemption.py) reliably land
                    mid-work on hosts of any speed
+    stop_at=K      every worker exits cleanly right after checkpoint K —
+                   simulates a whole-job preemption for the durable-spill
+                   resume tests (pair with rabit_checkpoint_dir=...)
 """
 
 import os
@@ -51,6 +54,7 @@ def main() -> int:
     ndata = int(getarg("ndata", "100"))
     niter = int(getarg("niter", "3"))
     pause = float(getarg("sleep", "0"))
+    stop_at = int(getarg("stop_at", "0"))
     use_local = getarg("local", "0") == "1"
     use_lazy = getarg("lazy", "0") == "1"
     preload_op = getarg("preload_op", "0") == "1"
@@ -127,6 +131,14 @@ def main() -> int:
         else:
             rt.checkpoint(model)
         check(rt.version_number() == it + 1, "version after checkpoint")
+        if stop_at and it + 1 == stop_at:
+            # Whole-job preemption simulation: every worker reaches this
+            # same version and exits together, cleanly.
+            check(model["history"] == list(range(stop_at)),
+                  f"history at stop {model['history']}")
+            rt.tracker_print(f"[{rank}] stopping at version {stop_at}")
+            rt.finalize()
+            return 0
 
     check(model["history"] == list(range(niter)), f"history {model['history']}")
     rt.tracker_print(f"[{rank}] all {niter} iterations verified")
