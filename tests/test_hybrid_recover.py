@@ -28,12 +28,14 @@ WORKER = str(Path(__file__).parent / "workers" / "gbdt_hybrid_worker.py")
 
 
 def run_cluster(nworkers, worker_args, out: Path, max_restarts=10,
-                timeout=420.0, preempt=None):
+                timeout=420.0, preempt=None, expect_out=True):
     cmd = [sys.executable, WORKER, "rabit_engine=mock", f"out={out}",
            *worker_args]
     cluster = LocalCluster(nworkers, max_restarts=max_restarts, quiet=True)
     assert cluster.run(cmd, timeout=timeout, preempt=preempt) == 0
     assert all(rc == 0 for rc in cluster.returncodes)
+    if not expect_out:  # a stop_at= run exits before writing the forest
+        return cluster, None
     return cluster, np.load(out.with_suffix(".npy"))
 
 
@@ -75,6 +77,19 @@ def test_hybrid_multi_death_same_step(clean_forest, tmp_path):
     """Two workers die at the same histogram allreduce (die_same)."""
     got = run_cluster(4, ["ntrees=4", "mock=0,1,0,0;2,1,0,0"],
                       tmp_path / "k4")[1]
+    assert np.array_equal(got, clean_forest)
+
+
+def test_hybrid_whole_job_preemption_resume(clean_forest, tmp_path):
+    """ALL workers die at once (slice-wide preemption, simulated by a
+    clean whole-cluster stop after tree 2) — in-memory state is gone, but
+    with rabit_checkpoint_dir the second job resumes from disk: forests
+    and per-rank margins reload, device arrays rebuild, and the final
+    forest is byte-identical to the single uninterrupted run."""
+    d = f"rabit_checkpoint_dir={tmp_path / 'ckpt'}"
+    run_cluster(4, ["ntrees=4", "stop_at=2", d], tmp_path / "j1",
+                max_restarts=0, expect_out=False)
+    _, got = run_cluster(4, ["ntrees=4", d], tmp_path / "j2", max_restarts=0)
     assert np.array_equal(got, clean_forest)
 
 

@@ -77,6 +77,10 @@ def main() -> int:
     # duration so timed external preemptions land mid-training on hosts of
     # any speed (tests/test_hybrid_recover.py::test_hybrid_external_preemption).
     pause = float(getarg("pause", "0"))
+    # stop_at=K: every worker exits cleanly right after checkpointing
+    # tree K — whole-job preemption simulation for the durable-spill
+    # resume test (pair with rabit_checkpoint_dir=...).
+    stop_at = int(getarg("stop_at", "0"))
     rt.init()
     rank, world = rt.get_rank(), rt.get_world_size()
 
@@ -137,6 +141,10 @@ def main() -> int:
             np.asarray(state.margin),                    # local: my margin
         )
         check(rt.version_number() == t + 1, "version after checkpoint")
+        if stop_at and t + 1 == stop_at:
+            rt.tracker_print(f"[{rank}] stopping after tree {stop_at}")
+            rt.finalize()
+            return 0
 
     # every worker must have grown the identical forest
     mine = pack_forest(state.forest)
