@@ -87,9 +87,11 @@ def test_hybrid_whole_job_preemption_resume(clean_forest, tmp_path):
     and per-rank margins reload, device arrays rebuild, and the final
     forest is byte-identical to the single uninterrupted run."""
     d = f"rabit_checkpoint_dir={tmp_path / 'ckpt'}"
-    run_cluster(4, ["ntrees=4", "stop_at=2", d], tmp_path / "j1",
-                max_restarts=0, expect_out=False)
-    _, got = run_cluster(4, ["ntrees=4", d], tmp_path / "j2", max_restarts=0)
+    c1, _ = run_cluster(4, ["ntrees=4", "stop_at=2", d], tmp_path / "j1",
+                        max_restarts=0, expect_out=False)
+    assert any("stopping after tree 2" in m for m in c1.messages)
+    c2, got = run_cluster(4, ["ntrees=4", d], tmp_path / "j2", max_restarts=0)
+    assert any("resumed at version 2" in m for m in c2.messages)
     assert np.array_equal(got, clean_forest)
 
 

@@ -125,6 +125,10 @@ def main() -> int:
         # forest, this rank's ring-replicated margin back onto its local
         # mesh sharding, round counter from the checkpoint version.
         check(margin_np is not None, "restarted worker got no local margin")
+        if int(os.environ.get("DMLC_NUM_ATTEMPT", "0")) == 0:
+            # First life with version > 0 = durable-spill resume (vs the
+            # restarted-life peer recovery) — asserted by the resume test.
+            rt.tracker_print(f"[{rank}] resumed at version {version}")
         state = gbdt.TrainState(
             forest=gbdt.Forest(*(jnp.asarray(a) for a in gmodel)),
             margin=jax.device_put(margin_np, rows),

@@ -88,6 +88,11 @@ def main() -> int:
         rt.tracker_print(
             f"[{rank}] recovered_at={time.time():.6f} version={version}"
         )
+    elif version > 0:
+        # First life yet version > 0: state came off the durable spill
+        # (rabit_checkpoint_dir) — the resume tests assert this marker so
+        # they cannot pass vacuously by retraining from scratch.
+        rt.tracker_print(f"[{rank}] resumed from disk at version {version}")
 
     for it in range(version, niter):
         if pause:
