@@ -228,7 +228,7 @@ def _disk_resume():
     Returns (base_version, gblob, lblob) — (0, None, None) when there is
     nothing on disk anywhere."""
     engine = _get_engine()
-    mine = np.array([_ckpt_store.latest()], np.int64)
+    mine = np.array([_ckpt_store.latest_valid()], np.int64)
     vmax = int(engine.allreduce(mine, MAX, cache_key="rabit_tpu.store::vmax")[0])
     if vmax <= 0:
         return 0, None, None

@@ -20,10 +20,18 @@ from __future__ import annotations
 
 import os
 import re
+import struct
+import zlib
 from pathlib import Path
 
 _GLOBAL_RE = re.compile(r"^global_r(\d+)_v(\d+)\.bin$")
 _KEEP = 2  # two-phase commit skews live ranks by at most one version
+# File layout: magic + crc32 + payload length, then the payload.  A file
+# that fails the check (torn by a crash the rename protocol could not
+# cover, or bit-rotted) reads as ABSENT, so resume degrades to an older
+# version or the holder-broadcast path instead of crashing on garbage.
+_MAGIC = b"RTC1"
+_HDR = struct.Struct("<4sII")
 
 
 class CheckpointStore:
@@ -37,6 +45,7 @@ class CheckpointStore:
         # directory — O(world^2) dirent reads per round on network
         # filesystems otherwise.
         self._versions: list[int] = []
+        self._cache: dict[Path, bytes] = {}  # verified payloads by path
         for p in self.dir.iterdir():
             if p.suffix == ".tmp" and f"_r{rank}_" in p.name:
                 p.unlink(missing_ok=True)
@@ -65,16 +74,19 @@ class CheckpointStore:
             self._versions.sort()
         while len(self._versions) > _KEEP:
             v = self._versions.pop(0)
-            self._gpath(v).unlink(missing_ok=True)
-            self._lpath(v).unlink(missing_ok=True)
+            for p in (self._gpath(v), self._lpath(v)):
+                p.unlink(missing_ok=True)
+                self._cache.pop(p, None)
 
     def _write(self, path: Path, blob: bytes) -> None:
         tmp = path.with_suffix(".tmp")
         with open(tmp, "wb") as f:
+            f.write(_HDR.pack(_MAGIC, zlib.crc32(blob), len(blob)))
             f.write(blob)
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, path)  # atomic: readers see old or new, never torn
+        self._cache[path] = blob
         # The rename itself must survive a host crash too — fsync the
         # directory entry, or the "durable" newest version can vanish on
         # power loss while the prune of the older one persisted.
@@ -90,15 +102,52 @@ class CheckpointStore:
         """This rank's persisted versions, ascending."""
         return list(self._versions)
 
-    def latest(self) -> int:
-        return self._versions[-1] if self._versions else 0
+    def latest_valid(self) -> int:
+        """Newest version whose global blob passes the integrity check —
+        what this rank may truthfully advertise to the resume consensus
+        (advertising a corrupt file could elect an unservable vmax)."""
+        for v in reversed(self._versions):
+            if self.has(v):
+                return v
+        return 0
+
+    def _read_checked(self, path: Path) -> bytes | None:
+        """The payload, or None when missing/torn/corrupt.  Verified reads
+        are memoized so the resume path (latest_valid -> has -> load) does
+        not re-read multi-MB blobs; writes/prunes keep the memo fresh."""
+        if path in self._cache:
+            return self._cache[path]
+        try:
+            raw = path.read_bytes()
+        except FileNotFoundError:
+            return None
+        bad = len(raw) < _HDR.size
+        if not bad:
+            magic, crc, n = _HDR.unpack_from(raw)
+            blob = raw[_HDR.size:]
+            bad = magic != _MAGIC or len(blob) != n or zlib.crc32(blob) != crc
+        if bad:
+            print(f"[rabit_tpu] checkpoint store: ignoring unreadable blob "
+                  f"{path} (missing/invalid RTC1 header or crc mismatch)",
+                  flush=True)
+            return None
+        self._cache[path] = blob
+        return blob
 
     def has(self, version: int) -> bool:
-        return version > 0 and self._gpath(version).exists()
+        """True only for a version whose global blob passes the integrity
+        check — the resume consensus must not promise bytes it cannot
+        serve."""
+        return version > 0 and self._read_checked(self._gpath(version)) is not None
 
     def load_global(self, version: int) -> bytes:
-        return self._gpath(version).read_bytes()
+        blob = self._read_checked(self._gpath(version))
+        if blob is None:
+            raise RuntimeError(
+                f"checkpoint store: global v{version} for rank {self.rank} "
+                f"is missing or corrupt ({self._gpath(version)})"
+            )
+        return blob
 
     def load_local(self, version: int) -> bytes | None:
-        p = self._lpath(version)
-        return p.read_bytes() if p.exists() else None
+        return self._read_checked(self._lpath(version))

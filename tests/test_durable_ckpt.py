@@ -72,6 +72,19 @@ def test_missing_rank_files_served_by_broadcast(tmp_path):
     assert any("all 6 iterations verified" in m for m in c2.messages)
 
 
+def test_corrupt_file_degrades_to_broadcast(tmp_path):
+    """A torn/bit-rotted blob must read as ABSENT (crc check), so the
+    corrupt rank is served by a holder's broadcast instead of the whole
+    resume crashing on garbage bytes."""
+    d = f"rabit_checkpoint_dir={tmp_path}"
+    run(4, ["niter=6", "stop_at=3", d])
+    victim = sorted(tmp_path.glob("global_r1_*.bin"))[-1]
+    victim.write_bytes(victim.read_bytes()[: victim.stat().st_size // 2])
+    c2 = run(4, ["niter=6", d])
+    assert any("all 6 iterations verified" in m for m in c2.messages)
+    assert any("resumed from disk at version 3" in m for m in c2.messages)
+
+
 def test_solo_resume(tmp_path):
     """Disk resume also works for a single process with no tracker."""
     def solo(args):
