@@ -16,6 +16,7 @@ from rabit_tpu.parallel.collectives import (
     ring_reduce_scatter,
     ring_allgather,
     ring_allreduce,
+    ring_allreduce_quantized,
     fused_allreduce,
 )
 from rabit_tpu.parallel.ring import (
@@ -38,6 +39,7 @@ __all__ = [
     "ring_reduce_scatter",
     "ring_allgather",
     "ring_allreduce",
+    "ring_allreduce_quantized",
     "fused_allreduce",
     "ring_attention",
     "ulysses_attention",

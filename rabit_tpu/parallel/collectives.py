@@ -136,6 +136,97 @@ def ring_allreduce(x: Array, axis_name: str) -> Array:
     return gathered.reshape(x.shape)
 
 
+def _quantize_i8(v: Array, block: int, planes: int):
+    """Per-block symmetric int8 quantization: returns (q[planes, m, block]
+    int8, scales[m, 1] f32).  planes=1 is plain int8 (~2^-8 of block max);
+    planes=2 adds a residual plane (~2^-16 of block max — the same hi/lo
+    trick as ops.boost's MXU encoder).  v must be 1-D, length a multiple
+    of ``block``."""
+    vb = v.reshape(-1, block)
+    amax = jnp.max(jnp.abs(vb), axis=1, keepdims=True)
+    scale = jnp.maximum(amax, jnp.float32(1e-30)) * (1.0 / 127.0)
+    a = jnp.clip(jnp.round(vb / scale), -127, 127)
+    if planes == 1:
+        return a.astype(jnp.int8)[None], scale
+    b = jnp.round((vb - a * scale) * (254.0 / scale))  # |resid| <= s/2 => |b| <= 127
+    return jnp.stack([a, b]).astype(jnp.int8), scale
+
+
+def _dequantize_i8(q: Array, scale: Array) -> Array:
+    v = q[0].astype(jnp.float32) * scale
+    if q.shape[0] == 2:
+        v = v + q[1].astype(jnp.float32) * (scale * (1.0 / 254.0))
+    return v.reshape(-1)
+
+
+def ring_allreduce_quantized(x: Array, axis_name: str, *,
+                             block: int = 256, planes: int = 2) -> Array:
+    """Bandwidth-compressed ring allreduce (SUM): every ICI/DCN hop ships
+    int8 payloads with per-``block`` f32 scales, and all arithmetic stays
+    f32 on device (EQuARX-class technique; PAPERS.md).  ``planes=2`` (the
+    default) sends a hi/lo int8 pair — ~2x fewer wire bytes than f32 at
+    ~2^-16-of-block-max accuracy per hop; ``planes=1`` sends one plane —
+    ~3.9x compression at ~2^-8 per hop.  Reduce-scatter hops re-quantize
+    the running partial sum (errors accumulate over the n-1 hops); the
+    allgather phase quantizes each owner's final chunk ONCE and forwards
+    the identical payload, adding a single quantization.
+
+    LOSSY and opt-in: paths with bit-exactness guarantees (the robust
+    replay contract, hybrid byte-identical recovery) must keep the exact
+    collectives.  Every rank decodes identical wire bits, but compiler
+    fusion may round the owner's local decode differently from a
+    receiver's, so copies agree to f32 rounding (~1 ulp), not bitwise.
+    f32 input, leading dim divisible by the axis size, chunk elements
+    divisible by ``block``."""
+    if planes not in (1, 2):
+        raise ValueError(f"ring_allreduce_quantized: planes must be 1 or 2, "
+                         f"got {planes}")
+    if x.dtype != jnp.float32:
+        raise ValueError(
+            f"ring_allreduce_quantized: f32 input required (got {x.dtype}); "
+            "cast first — accumulation runs in f32 regardless"
+        )
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    perm = ring_perm(n)
+    chunks = x.reshape((n, x.shape[0] // n) + x.shape[1:])
+    csize = chunks[0].size
+    if csize % block:
+        raise ValueError(
+            f"ring_allreduce_quantized: chunk size {csize} not divisible by "
+            f"block {block} (pad the payload or pick a divisor block)"
+        )
+
+    def rs_body(s, carry):
+        """Quantize the running partial sum, hop it, fold in my chunk."""
+        held = carry
+        recv_q, recv_s = lax.ppermute(
+            _quantize_i8(held.reshape(-1), block, planes), axis_name, perm)
+        mine = lax.dynamic_index_in_dim(chunks, (idx - 2 - s) % n,
+                                        keepdims=False)
+        return _dequantize_i8(recv_q, recv_s).reshape(mine.shape) + mine
+
+    init = lax.dynamic_index_in_dim(chunks, (idx - 1) % n, keepdims=False)
+    owned = lax.fori_loop(0, n - 1, rs_body, init)
+
+    # Allgather: ONE quantization per owner; the int8 payload is forwarded
+    # verbatim so hops add no further error.
+    q0, s0 = _quantize_i8(owned.reshape(-1), block, planes)
+    out = jnp.zeros((n, csize), jnp.float32)
+    out = lax.dynamic_update_index_in_dim(
+        out, _dequantize_i8(q0, s0), idx, 0)
+
+    def ag_body(s, carry):
+        out, q, sc = carry
+        q, sc = lax.ppermute((q, sc), axis_name, perm)
+        out = lax.dynamic_update_index_in_dim(
+            out, _dequantize_i8(q, sc), (idx - s - 1) % n, 0)
+        return out, q, sc
+
+    out, _, _ = lax.fori_loop(0, n - 1, ag_body, (out, q0, s0))
+    return out.reshape(x.shape)
+
+
 def fused_allreduce(tree: Any, axis_name: str, op: int = SUM) -> Any:
     """Allreduce a whole pytree as ONE collective per dtype group.
 
