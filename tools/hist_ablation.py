@@ -47,6 +47,9 @@ def main() -> int:
     ap.add_argument("--bins", type=int, default=256)
     ap.add_argument("--depth", type=int, default=6)
     ap.add_argument("--cpu", action="store_true")
+    ap.add_argument("--quick", action="store_true",
+                    help="only the pallas bf16-vs-i8 hist kernels at the "
+                         "deepest level — fits a short TPU-tunnel window")
     ap.add_argument("--json-out", default="")
     args = ap.parse_args()
 
@@ -83,23 +86,30 @@ def main() -> int:
         "scatter": hist.node_histograms_scatter,
         "onehot": hist.node_histograms_onehot,
     }
+    if args.quick:
+        if plat != "tpu":
+            print("--quick benchmarks only the Pallas TPU kernels; no TPU "
+                  "backend is active", file=sys.stderr)
+            return 2
+        impls = {}
     if plat == "tpu":
         impls["pallas"] = hist.node_histograms_pallas
         impls["pallas_i8"] = functools.partial(
             hist.node_histograms_pallas, mxu_i8=True)
-    for d in (0, args.depth - 1):
+    depths = (args.depth - 1,) if args.quick else (0, args.depth - 1)
+    for d in depths:
         n_nodes = 1 << d
         node = jnp.asarray(rng.randint(0, n_nodes, size=args.rows), jnp.int32)
         for name, fn in impls.items():
             f = jax.jit(functools.partial(
                 fn, n_nodes=n_nodes, n_bins=args.bins))
-            dt = timed(f, xb, g, h, node)
+            dt = timed(f, xb, g, h, node, n=3 if args.quick else 5)
             emit({"kernel": f"hist_{name}", "n_nodes": n_nodes,
                   "ms": round(dt * 1e3, 3)})
 
     # Fused route+hist level step vs the hist alone: the difference is the
     # routing cost the fused kernel folds into the same HBM pass.
-    if plat == "tpu":
+    if plat == "tpu" and not args.quick:
         xb3, _ = boost.block_rows(xb)
         g3, _ = boost.block_rows(g)
         h3, _ = boost.block_rows(h)
