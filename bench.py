@@ -44,6 +44,17 @@ TPU_CHILD_TIMEOUT = 480.0  # the child compiles + times BOTH MXU modes
                            # run was 83s wall with 72s of compile, so two
                            # modes need ~170s; the rest is compile-wobble
                            # margin (round-2 verdict: 90s left ~7s)
+# Round-4 rework (round-3 verdict #1): the WHOLE TPU wall budget goes to
+# chip attempts.  Round 3 burned 90s on two probes, then went straight to
+# the forced-CPU child with ~380s of TPU budget left — and recorded a CPU
+# number that erased the chip's 14.3 rounds/s.  Now: first child attempt
+# launches immediately (capped so a wedged-at-init hang cannot eat the
+# whole budget), then a 45s-cadence probe loop re-tries the chip until
+# the budget line, with one last-ditch blind attempt near the end; the
+# numpy baseline measures in a parallel thread instead of serially after.
+TPU_WALL_BUDGET = float(os.environ.get("RABIT_BENCH_TPU_BUDGET_S", "480"))
+FIRST_ATTEMPT_CAP = 300.0  # healthy two-mode run ≈170s; a wedge leaves
+                           # budget for probe-gated retries
 CPU_CHILD_TIMEOUT = 90.0
 
 
@@ -243,34 +254,70 @@ def run_child(n_rows, n_rounds, force_cpu, timeout):
     return None
 
 
+def try_tpu_within_budget():
+    """Spend the full TPU wall budget attempting the chip.
+
+    Returns the child's result dict, or None if the budget expired without
+    a measurement.  Sequence: immediate first attempt (capped — a child
+    wedged at backend init salvages nothing, so it must not consume the
+    whole budget), then 45s-cadence probes gating further full attempts
+    (a probe success means the tunnel healed; children and probes never
+    overlap, the chip is single-tenant), then one blind last-ditch attempt
+    with whatever remains — the child prints its bf16 measurement the
+    moment it has one, so even a truncated attempt can salvage a number.
+    """
+    deadline = T_START + TPU_WALL_BUDGET
+    remaining = lambda: deadline - time.time()
+    attempt = 0
+    while remaining() > 30:
+        attempt += 1
+        if attempt == 1:
+            t = min(TPU_CHILD_TIMEOUT, FIRST_ATTEMPT_CAP, remaining())
+            log(f"TPU attempt 1 (timeout {t:.0f}s of {remaining():.0f}s budget)")
+            res = run_child(N_ROWS, TPU_ROUNDS, force_cpu=False, timeout=t)
+            if isinstance(res, dict):
+                return res
+            continue
+        if remaining() < 150:
+            # Not enough left for probe + full attempt: go blind with the
+            # rest.  A healthy backend gets the bf16 number out in ~90s.
+            t = remaining()
+            log(f"last-ditch blind TPU attempt ({t:.0f}s left)")
+            res = run_child(N_ROWS, TPU_ROUNDS, force_cpu=False, timeout=t)
+            return res if isinstance(res, dict) else None
+        if probe_device(timeout=min(45.0, remaining())):
+            t = min(TPU_CHILD_TIMEOUT, remaining())
+            log(f"probe OK; TPU attempt {attempt} (timeout {t:.0f}s)")
+            res = run_child(N_ROWS, TPU_ROUNDS, force_cpu=False, timeout=t)
+            if isinstance(res, dict):
+                return res
+        else:
+            time.sleep(min(10, max(0, remaining() - 150)))
+    return None
+
+
 def main():
     log(f"dataset: {N_ROWS} rows x {N_FEATURES} feats, {N_BINS} bins, depth {DEPTH}")
-    res = None
-    if not probe_device():
-        # One more chance — transient tunnel hiccups do heal.
-        log("probe failed; retrying probe once")
-        res = "timeout" if not probe_device() else None
-    if res is None:
-        res = run_child(N_ROWS, TPU_ROUNDS, force_cpu=False, timeout=TPU_CHILD_TIMEOUT)
-    if res is None:
-        # Fast failure (UNAVAILABLE etc.) is often transient: retry once.
-        # A hang ("timeout") persists — don't burn another full timeout on it.
-        log("retrying TPU child once")
-        res = run_child(N_ROWS, TPU_ROUNDS, force_cpu=False, timeout=TPU_CHILD_TIMEOUT)
+    # Numpy baseline FIRST: it is a ~2s subsample-and-scale measurement, and
+    # taking it before any child exists means it never contends with the TPU
+    # child's host-CPU-heavy compile phase (which would inflate the baseline
+    # and flatter vs_baseline).
+    baseline_1m = bench_cpu_scaled(N_ROWS)
+    log(f"numpy baseline: {baseline_1m * 1e3:.1f} ms/round at {N_ROWS} rows")
+    res = try_tpu_within_budget()
     n_rows = N_ROWS
     if not isinstance(res, dict):
         # Forced-CPU fallback: smaller problem so the jitted round fits the
         # budget; the line is labelled with platform+rows.
         n_rows = N_ROWS // 8
-        log(f"falling back to forced-CPU child at {n_rows} rows")
+        log(f"TPU budget exhausted; falling back to forced-CPU child at {n_rows} rows")
         res = run_child(n_rows, 2, force_cpu=True, timeout=CPU_CHILD_TIMEOUT)
     if not isinstance(res, dict):
         # Last resort: numpy-only numbers, so the driver still gets a line.
         log("device bench unavailable; reporting numpy-only baseline")
-        cpu_time = bench_cpu_scaled(N_ROWS)
         print(json.dumps({
             "metric": "gbdt_hist_rounds_per_sec_1M_rows",
-            "value": round(1.0 / cpu_time, 3),
+            "value": round(1.0 / baseline_1m, 3),
             "unit": "rounds/s",
             "vs_baseline": 1.0,
             "platform": "numpy-fallback",
@@ -279,8 +326,14 @@ def main():
         }), flush=True)
         return
     device_time = res["device_time"]
-    log(f"device per-round: {device_time * 1e3:.1f} ms on {res['platform']}; measuring numpy baseline")
-    cpu_time = bench_cpu_scaled(n_rows)
+    log(f"device per-round: {device_time * 1e3:.1f} ms on {res['platform']}")
+    if n_rows == N_ROWS:
+        cpu_time = baseline_1m
+    else:
+        # vs_baseline is a same-size ratio; bincount scaling is not quite
+        # linear at small sizes, so measure at the fallback size directly
+        # (sub-second) rather than rescaling the 1M figure.
+        cpu_time = bench_cpu_scaled(n_rows)
     log(f"numpy per-round (scaled to {n_rows} rows): {cpu_time * 1e3:.1f} ms")
     # The metric is defined at 1M rows.  If the fallback measured a smaller
     # problem, rescale to the 1M-row-equivalent rate (the round is linear in

@@ -1,0 +1,63 @@
+#!/bin/bash
+# Poll the TPU backend; the moment it answers, capture the on-chip
+# measurements round 3 could not get (RESULTS.md "watcher target"):
+#   1. --quick pallas bf16-vs-i8 hist kernels   -> RESULTS/hist_ablation_i8_quick.jsonl
+#   2. full ablation incl. whole-round i8 rows  -> RESULTS/hist_ablation_i8.jsonl
+#   3. driver bench                             -> RESULTS/bench_watch.json
+# Each stage writes to a temp file and promotes it only when it holds the
+# evidence the stage exists for, so a later tunnel death can never clobber
+# an already-captured good artifact.  The watcher yields the chip to any
+# foreground bench.py (the chip is single-tenant), and exits only when the
+# full-ablation i8 rows AND a platform:"tpu" bench line are both on disk.
+# Log: RESULTS/tpu_watch.log
+cd "$(dirname "$0")/.." || exit 1
+LOG=RESULTS/tpu_watch.log
+echo "[watch $(date +%T)] watcher start" >> "$LOG"
+
+bench_running() {
+  # Another process (the driver, or a manual run) is using the chip.
+  pgrep -f "bench\.py" >/dev/null 2>&1
+}
+
+promote() {  # promote TMP DST PATTERN — move TMP over DST iff TMP has PATTERN
+  local tmp=$1 dst=$2 pat=$3
+  if [ -s "$tmp" ] && grep -q "$pat" "$tmp"; then
+    mv "$tmp" "$dst"
+    echo "[watch $(date +%T)] promoted $dst" >> "$LOG"
+  else
+    rm -f "$tmp"
+  fi
+}
+
+have() { [ -s "$1" ] && grep -q "$2" "$1"; }
+
+while true; do
+  if bench_running; then
+    sleep 30
+    continue
+  fi
+  if timeout 45 python -c "import jax, jax.numpy as jnp; print(int(jnp.arange(4).sum()))" >/dev/null 2>&1; then
+    echo "[watch $(date +%T)] TPU ALIVE — capturing" >> "$LOG"
+    if ! have RESULTS/hist_ablation_i8_quick.jsonl hist_pallas_i8; then
+      timeout 240 python tools/hist_ablation.py --quick \
+        --json-out RESULTS/.i8q.tmp >> "$LOG" 2>&1
+      promote RESULTS/.i8q.tmp RESULTS/hist_ablation_i8_quick.jsonl hist_pallas_i8
+    fi
+    if ! have RESULTS/hist_ablation_i8.jsonl train_round_fused_i8; then
+      bench_running || timeout 900 python tools/hist_ablation.py \
+        --json-out RESULTS/.i8.tmp >> "$LOG" 2>&1
+      promote RESULTS/.i8.tmp RESULTS/hist_ablation_i8.jsonl train_round_fused_i8
+    fi
+    if ! have RESULTS/bench_watch.json '"platform": "tpu"'; then
+      bench_running || timeout 900 python bench.py > RESULTS/.bw.tmp 2>> "$LOG"
+      promote RESULTS/.bw.tmp RESULTS/bench_watch.json '"platform": "tpu"'
+    fi
+    if have RESULTS/hist_ablation_i8.jsonl train_round_fused_i8 && \
+       have RESULTS/bench_watch.json '"platform": "tpu"'; then
+      echo "[watch $(date +%T)] all captures complete; watcher exiting" >> "$LOG"
+      exit 0
+    fi
+    echo "[watch $(date +%T)] captures incomplete; continuing to poll" >> "$LOG"
+  fi
+  sleep 75
+done
