@@ -242,7 +242,19 @@ def _disk_resume():
     # Someone's disk copy is missing/stale: the lowest-ranked holder serves
     # the (rank-identical) global blob over a broadcast.  Rank-specific
     # local models cannot be served this way; a rank without its own file
-    # resumes with local_model=None.
+    # resumes with local_model=None (warned below — the caller must be able
+    # to rebuild rank-local state, see doc/guide.md "Durable spill").
+    if not have:
+        import warnings
+
+        warnings.warn(
+            f"rabit_tpu durable resume: rank {engine.get_rank()} has no "
+            f"valid disk checkpoint for v{vmax} (killed between the commit "
+            "barrier and its disk save?); the global model is served by a "
+            "peer but any rank-local model is LOST — load_checkpoint will "
+            "return local_model=None and the caller must rebuild it",
+            stacklevel=3,
+        )
     world = engine.get_world_size()
     root = int(
         engine.allreduce(

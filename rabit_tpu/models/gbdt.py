@@ -48,7 +48,8 @@ class GBDTConfig(NamedTuple):
     objective: str = "logistic"  # "logistic" | "squared"
     # Run the histogram contraction at int8 MXU rate (2x bf16 on
     # v5e-class chips) via a two-plane fixed-point split of the gradient
-    # matrix; ~2^-13-of-block-max accuracy vs ~2^-16-relative for the
+    # matrix; ~2^-14-of-block-max round-off (ops/boost.py _encode_i8)
+    # vs ~2^-16-relative for the
     # default hi/lo-bf16 split.  Honored by every TPU Pallas dispatch —
     # fused and hook-based rounds alike; non-TPU backends (exact-f32
     # scatter) ignore it.

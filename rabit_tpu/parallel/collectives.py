@@ -148,7 +148,10 @@ def _quantize_i8(v: Array, block: int, planes: int):
     a = jnp.clip(jnp.round(vb / scale), -127, 127)
     if planes == 1:
         return a.astype(jnp.int8)[None], scale
-    b = jnp.round((vb - a * scale) * (254.0 / scale))  # |resid| <= s/2 => |b| <= 127
+    # |resid| <= s/2 => |b| <= 127 analytically, but the bound has only
+    # ~1e-5 of f32 headroom and int8 astype WRAPS on overflow (and on
+    # non-finite input), so clip like the primary plane — free vs the op.
+    b = jnp.clip(jnp.round((vb - a * scale) * (254.0 / scale)), -127, 127)
     return jnp.stack([a, b]).astype(jnp.int8), scale
 
 

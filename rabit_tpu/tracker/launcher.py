@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import argparse
 import os
+import signal
 import subprocess
 import sys
 import time
@@ -96,7 +97,16 @@ class LocalCluster:
                     if proc is None:
                         continue  # finished cleanly — nothing to preempt
                     proc.kill()
-                    self.preempts_delivered += 1
+                    # kill() on a child that exited between the poll()
+                    # above and here is a silent no-op; only count the
+                    # preemption as delivered when the reaped status shows
+                    # the SIGKILL actually landed (returncode -9).
+                    try:
+                        rc = proc.wait(timeout=5)
+                    except subprocess.TimeoutExpired:
+                        rc = -signal.SIGKILL  # kill sent, reap pending
+                    if rc == -signal.SIGKILL:
+                        self.preempts_delivered += 1
                     if not self.quiet:
                         print(f"[launcher] preempted worker {idx} "
                               f"(SIGKILL)", flush=True)
