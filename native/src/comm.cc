@@ -61,6 +61,8 @@ void Comm::Configure(const Config& cfg) {
   tree_minsize_ = cfg.GetSize("rabit_tree_reduce_minsize", 1 << 20);
   reduce_buffer_ = std::max<size_t>(cfg.GetSize("rabit_reduce_buffer", 256u << 20), 64);
   tcp_no_delay_ = cfg.GetBool("rabit_enable_tcp_no_delay", false);
+  bootstrap_timeout_sec_ =
+      static_cast<double>(cfg.GetInt("rabit_bootstrap_timeout_sec", 60));
   // Hung-peer stall bound.  Engine-dependent default (default_stall_sec_,
   // set before Configure): the robust engine turns a false positive into a
   // recoverable re-bootstrap, so it defaults on; the base engine would die
@@ -127,17 +129,44 @@ void Comm::Init(bool recover) {
     listen_.Create();
     listen_port_ = listen_.BindListen();
   }
-  TcpSocket tr;
-  ConnectTracker(&tr);
-  SendHello(&tr, recover ? kCmdRecover : kCmdStart);
-  RecvAssignment(&tr);
-  tr.Close();
-  BuildLinks();
+  for (;;) {
+    TcpSocket tr;
+    ConnectTracker(&tr);
+    SendHello(&tr, recover ? kCmdRecover : kCmdStart);
+    RecvAssignment(&tr);
+    tr.Close();
+    bool ok = false;
+    try {
+      ok = BuildLinks();
+    } catch (const Error& e) {
+      fprintf(stderr, "[rank %d] bootstrap epoch %d failed: %s\n", rank_,
+              epoch_, e.what());
+    }
+    if (ok) break;
+    // A peer assigned in this wave died before its links came up (the
+    // initial-bootstrap liveness hole: a worker killed between tracker
+    // check-in and peer dial would otherwise strand its accept-side peers
+    // forever).  Close partial links and re-enter the tracker as a
+    // recover wave: every stranded survivor times out the same way, the
+    // launcher restarts the dead worker, and the next wave's fresh epoch
+    // completes.  The robust engine's watchdog bounds total time here.
+    CloseLinks();
+    recover = true;
+    fprintf(stderr,
+            "[rank %d] re-entering tracker after incomplete bootstrap "
+            "(epoch %d)\n",
+            rank_, epoch_);
+  }
   initialized_ = true;
 }
 
-void Comm::BuildLinks() {
+bool Comm::BuildLinks() {
   CloseLinks();
+  const double deadline =
+      bootstrap_timeout_sec_ > 0 ? NowSec() + bootstrap_timeout_sec_ : 0;
+  auto remaining = [&]() {
+    return deadline == 0 ? 3600.0 : deadline - NowSec();
+  };
   std::set<int> neighbors;
   if (parent_ >= 0) neighbors.insert(parent_);
   for (int c : children_) neighbors.insert(c);
@@ -148,14 +177,22 @@ void Comm::BuildLinks() {
   neighbors.erase(rank_);
 
   // Lower rank dials, higher rank accepts.  Every worker is listening
-  // before the tracker releases the assignment wave, so dials always land.
+  // before the tracker releases the assignment wave, so dials land unless
+  // the peer died after check-in — ECONNREFUSED (its listener closed with
+  // the process), reported as a failed wave rather than thrown.
   int expect_accept = 0;
   for (int peer : neighbors) {
     if (peer > rank_) {
       auto it = peers_.find(peer);
       TRT_CHECK(it != peers_.end(), "no address for peer %d", peer);
       TcpSocket s;
-      s.Connect(it->second.first, it->second.second);
+      try {
+        s.Connect(it->second.first, it->second.second);
+      } catch (const Error&) {
+        fprintf(stderr, "[rank %d] bootstrap: peer %d unreachable\n", rank_,
+                peer);
+        return false;
+      }
       uint32_t hello[3] = {kMagicLink, static_cast<uint32_t>(rank_),
                            static_cast<uint32_t>(epoch_)};
       s.SendAll(hello, sizeof(hello));
@@ -165,9 +202,24 @@ void Comm::BuildLinks() {
     }
   }
   while (expect_accept > 0) {
+    if (remaining() <= 0 || !listen_.WaitAcceptable(remaining())) {
+      fprintf(stderr,
+              "[rank %d] bootstrap: %d expected link(s) never arrived "
+              "within %.0fs\n",
+              rank_, expect_accept, bootstrap_timeout_sec_);
+      return false;
+    }
     TcpSocket s = listen_.Accept();
+    // Bound the hello read too: a dialer that connected and then died
+    // sends nothing, and an unbounded RecvAll would re-open the hole.
+    s.SetRecvTimeout(std::max(remaining(), 1.0));
     uint32_t hello[3];
-    s.RecvAll(hello, sizeof(hello));
+    try {
+      s.RecvAll(hello, sizeof(hello));
+    } catch (const Error&) {
+      continue;  // dialer died mid-hello; its restart will re-wave us
+    }
+    s.SetRecvTimeout(0);
     if (hello[0] != kMagicLink ||
         static_cast<int>(hello[2]) != epoch_) {
       continue;  // stale dialer from a previous epoch; drop
@@ -183,6 +235,7 @@ void Comm::BuildLinks() {
     sock.SetKeepAlive(true);
     if (tcp_no_delay_) sock.SetNoDelay(true);
   }
+  return true;
 }
 
 void Comm::CloseLinks() {

@@ -85,7 +85,7 @@ class Comm {
   void ConnectTracker(TcpSocket* sock) const;
   void SendHello(TcpSocket* sock, uint32_t cmd) const;
   void RecvAssignment(TcpSocket* sock);
-  void BuildLinks();
+  bool BuildLinks();  // false = a wave peer is unreachable; caller re-waves
   TcpSocket* LinkTo(int peer_rank);
 
   Config cfg_;
@@ -120,6 +120,12 @@ class Comm {
   // defaults it off (SetDefaultStallSec).
   int default_stall_sec_ = 300;
   int stall_ms_ = 300000;
+  // Bound on one link-building pass (rabit_bootstrap_timeout_sec; 0 = wait
+  // forever).  A worker that died between tracker assignment and dialing
+  // strands its accept-side peers; on expiry the survivor closes partial
+  // links and re-enters the tracker as a recover wave, which converges
+  // once the launcher restarts the dead worker (round-3 verdict item).
+  double bootstrap_timeout_sec_ = 60.0;
   bool tcp_no_delay_ = false;
   bool initialized_ = false;
 };

@@ -158,20 +158,28 @@ class RobustEngine : public Engine {
   void Init(const Config& cfg) override {
     cfg_ = cfg;
     comm_.Configure(cfg);
+    // The watchdog covers INITIAL bootstrap too (round-3 verdict: the
+    // reference bounds Init via rabit_timeout, allreduce_robust.cc:693-716
+    // — a never-restarted peer must not strand first Init forever).  Read
+    // the timeout before Init since the knob lives in the same Config.
+    timeout_sec_ = cfg.GetBool("rabit_timeout", true)
+                       ? static_cast<double>(cfg.GetInt("rabit_timeout_sec", 1800))
+                       : 0.0;
+    watchdog_.Arm(timeout_sec_, /*rank=*/-1);
     comm_.Init(/*recover=*/false);
+    watchdog_.Disarm();
     num_global_replica_ =
         std::max<int>(1, static_cast<int>(cfg.GetInt("rabit_global_replica", 5)));
     local_replica_cfg_ =
         std::max<int>(0, static_cast<int>(cfg.GetInt("rabit_local_replica", 2)));
     boot_cache_on_ = cfg.GetBool("rabit_bootstrap_cache", false);
     debug_ = cfg.GetBool("rabit_debug", false);
-    // Armed by DEFAULT during recovery (round-3 change; the reference left
-    // this opt-in, allreduce_base.h:581): a worker blocked in recovery for
-    // a dead-and-never-restarted or wedged peer must eventually abort so
+    // timeout_sec_ (armed by DEFAULT during recovery AND initial Init —
+    // round-3/4 change; the reference left this opt-in,
+    // allreduce_base.h:581): a worker blocked waiting for a
+    // dead-and-never-restarted or wedged peer must eventually abort so
     // the launcher can make forward progress.  rabit_timeout=0 disables.
-    timeout_sec_ = cfg.GetBool("rabit_timeout", true)
-                       ? static_cast<double>(cfg.GetInt("rabit_timeout_sec", 1800))
-                       : 0.0;
+    // Parsed above, before comm_.Init.
     // rabit_consensus_summary=0 forces the full table exchange every round
     // (testing / before-after measurement of the O(log W) fast path).
     use_summary_ = cfg.GetBool("rabit_consensus_summary", true);

@@ -53,6 +53,16 @@ int main(int argc, char* argv[]) {
   const int rank = tpurabit::GetRank();
   std::vector<float> buf(ndata);
 
+  // One untimed warmup pass: the very first collective on a fresh cluster
+  // pays link establishment + allocator warmup, which at small payloads is
+  // orders of magnitude above steady state — averaging it in made the
+  // small-payload latency rows meaningless (σ==mean in round-3 data).
+  for (size_t i = 0; i < ndata; ++i) buf[i] = static_cast<float>(rank + i);
+  tpurabit::Allreduce<tpurabit::op::Max>(buf.data(), ndata);
+  for (size_t i = 0; i < ndata; ++i) buf[i] = static_cast<float>(rank + i);
+  tpurabit::Allreduce<tpurabit::op::Sum>(buf.data(), ndata);
+  tpurabit::Broadcast(buf.data(), ndata * sizeof(float), 0);
+
   double t_max = 0, t_sum = 0, t_bcast = 0;
   for (int r = 0; r < nrep; ++r) {
     for (size_t i = 0; i < ndata; ++i) buf[i] = static_cast<float>(rank + i);

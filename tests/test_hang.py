@@ -32,9 +32,10 @@ import rabit_tpu as rt
 
 rt.init()
 rank, world = rt.get_rank(), rt.get_world_size()
-# Tell the test we are past bootstrap (the watchdog only covers RECOVERY,
-# like the reference's; stopping a worker still inside the initial tracker
-# wave would hang everyone in unprotected blocking recvs).
+# Tell the test we are past bootstrap, so the SIGSTOP lands mid-iteration
+# (the initial wave has its own bounded-bootstrap coverage — see
+# test_bootstrap_liveness.py — and this test targets the steady-state
+# stall detector, not the bootstrap path).
 with open(os.environ["HANG_READY_DIR"] + f"/ready.{rank}", "w") as f:
     f.write("1")
 for it in range(40):
