@@ -54,24 +54,52 @@ def run_once(world: int, extra: list[str], timeout: float | None = None):
     ]
     if stamps and cluster.death_times:
         latency = min(stamps) - cluster.death_times[0]
-    return dt, latency
+    # Protocol-event counters from the restarted worker's LoadCheckPoint
+    # (rabit_recover_stats=1): version>0 identifies the recovered life —
+    # first lives print version=0.  Scheduling-independent, unlike wall
+    # time at oversubscribed world sizes.
+    events = None
+    for m in cluster.messages:
+        if "recover_stats" not in m or "version=0 " in m:
+            continue
+        fields = dict(
+            kv.split("=") for kv in m.split() if "=" in kv
+        )
+        events = {
+            "summary_rounds": int(fields["summary_rounds"]),
+            "table_rounds": int(fields["table_rounds"]),
+            "serve_bytes": int(fields["serve_bytes"]),
+        }
+        break
+    return dt, latency, events
 
 
 def main() -> None:
     worlds = [int(w) for w in (sys.argv[1:] or ["4", "8"])]
     for world in worlds:
         clean = min(run_once(world, [])[0] for _ in range(2))
-        fails = [run_once(world, ["mock=1,1,1,0"]) for _ in range(2)]
+        fails = [
+            run_once(world, ["mock=1,1,1,0", "rabit_recover_stats=1"])
+            for _ in range(2)
+        ]
         failure = min(f[0] for f in fails)
         lats = [f[1] for f in fails if f[1] is not None]
-        print(json.dumps({
+        events = next((f[2] for f in fails if f[2] is not None), None)
+        rec = {
             "world": world,
             "clean_s": round(clean, 3),
             "failure_s": round(failure, 3),
             "recovery_overhead_s": round(failure - clean, 3),
             "protocol_recovery_latency_s":
                 round(min(lats), 3) if lats else None,
-        }), flush=True)
+        }
+        if events is not None:
+            rec.update(
+                recover_summary_rounds=events["summary_rounds"],
+                recover_table_rounds=events["table_rounds"],
+                recover_serve_bytes=events["serve_bytes"],
+            )
+        print(json.dumps(rec), flush=True)
 
 
 if __name__ == "__main__":
