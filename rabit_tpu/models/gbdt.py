@@ -334,15 +334,17 @@ def train_round_fused(
         thrs.append(jnp.zeros(max_nodes, jnp.int32).at[: 2 ** d].set(thr))
     # Leaf (g, h) masses come straight off the final combined histogram
     # (split_child_masses) — already globally reduced, so no leaf collective
-    # and no histogram work in the last row pass, which only routes rows to
-    # their leaves for the margin update (depth collectives per round, not
-    # depth+1).
+    # and no histogram work in the last row pass, which routes rows to
+    # their leaves AND applies the margin update in one fused kernel
+    # (depth collectives per round, not depth+1; no host-level 1M-row
+    # gather from the leaf table).
     leaf_gh = split_child_masses(hist, feat, thr)
-    node3 = boost.route_level(xb3, node3, feat, thr, depth=cfg.depth,
-                              interpret=interpret)
     leaf = -cfg.learning_rate * leaf_gh[:, 0] / (leaf_gh[:, 1] + cfg.reg_lambda)
-    node = boost.unblock_rows(node3, n)
-    margin = state.margin + leaf[node]
+    margin3, _ = boost.block_rows(state.margin, block)
+    margin3, _node3 = boost.route_margin_level(
+        xb3, node3, margin3, feat, thr, leaf, depth=cfg.depth,
+        interpret=interpret)
+    margin = boost.unblock_rows(margin3, n)
     t = state.round
     forest = Forest(
         feature=lax.dynamic_update_index_in_dim(

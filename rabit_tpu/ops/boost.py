@@ -182,6 +182,61 @@ def _route_kernel(xb_ref, node_ref, feat_ref, thr_ref, node_out_ref, *,
                              thr_ref[0:1], p_pad=p_pad, n_feat=n_feat)
 
 
+# -- final pass: route to leaves + margin update in one kernel -------------
+
+
+def _route_margin_kernel(xb_ref, node_ref, margin_ref, feat_ref, thr_ref,
+                         leaf_ref, margin_out_ref, node_out_ref, *,
+                         p_pad, l_pad, n_feat):
+    node = _route(xb_ref[0], node_ref[0], feat_ref[0:1], thr_ref[0:1],
+                  p_pad=p_pad, n_feat=n_feat)
+    node_out_ref[0] = node
+    # margin += leaf[node] without a gather: the leaf table is tiny (64
+    # entries at depth 6), so the same lane-masked reduction as _route's
+    # split lookup replaces XLA's slow 1M-row gather from a small table.
+    r = node.shape[0]
+    l_iota = lax.broadcasted_iota(jnp.int32, (r, l_pad), 1)
+    lv = jnp.sum(jnp.where(node == l_iota, leaf_ref[0:1], 0.0), axis=1,
+                 keepdims=True)
+    margin_out_ref[0] = margin_ref[0] + lv
+
+
+@functools.partial(jax.jit, static_argnames=("depth", "interpret"))
+def route_margin_level(xb3, node3, margin3, feat, thr, leaf, *, depth: int,
+                       interpret: bool = False):
+    """Final fused pass: route rows through the level-(depth-1) split tables
+    to their leaves AND apply the margin update ``margin += leaf[node]`` in
+    the same streaming pass.  Returns (margin3', leaf_node3).  Replaces
+    route_level + a host-level gather: XLA lowers a 1M-row gather from a
+    64-entry table poorly on TPU, while the in-kernel lane-masked sum is a
+    few VPU ops per row."""
+    nb, R, F = xb3.shape
+    n_prev = 2 ** (depth - 1)
+    n_leaves = 2 ** depth
+    p_pad = _round_up(n_prev, 128)
+    l_pad = _round_up(n_leaves, 128)
+    featp = jnp.zeros((8, p_pad), jnp.int32).at[0, :n_prev].set(feat)
+    thrp = jnp.zeros((8, p_pad), jnp.int32).at[0, :n_prev].set(thr)
+    leafp = jnp.zeros((8, l_pad), jnp.float32).at[0, :n_leaves].set(leaf)
+    return pl.pallas_call(
+        functools.partial(_route_margin_kernel, p_pad=p_pad, l_pad=l_pad,
+                          n_feat=F),
+        grid=(nb,),
+        in_specs=[
+            _blk(R, F), _blk(R, 1), _blk(R, 1),
+            pl.BlockSpec((8, p_pad), lambda i: (0, 0)),
+            pl.BlockSpec((8, p_pad), lambda i: (0, 0)),
+            pl.BlockSpec((8, l_pad), lambda i: (0, 0)),
+        ],
+        out_specs=[_blk(R, 1), _blk(R, 1)],
+        out_shape=[
+            jax.ShapeDtypeStruct((nb, R, 1), jnp.float32),
+            jax.ShapeDtypeStruct((nb, R, 1), jnp.int32),
+        ],
+        interpret=interpret,
+    )(xb3, node3, margin3, featp, thrp, leafp)
+
+
 @functools.partial(jax.jit, static_argnames=("depth", "interpret"))
 def route_level(xb3, node3, feat, thr, *, depth: int, interpret: bool = False):
     """Route rows one level down through the level-(depth-1) split tables —

@@ -65,6 +65,12 @@ def test_route_and_leaf_kernels_lower():
     export_tpu(
         functools.partial(boost.route_level, depth=6), xb3, node3, tab, tab
     )
+    margin3 = jnp.zeros((NB, R, 1), jnp.float32)
+    leaf = jnp.zeros(1 << 6, jnp.float32)
+    export_tpu(
+        functools.partial(boost.route_margin_level, depth=6),
+        xb3, node3, margin3, tab, tab, leaf,
+    )
     export_tpu(
         functools.partial(boost.leaf_fit, depth=6), xb3, node3, g3, h3, tab, tab
     )
