@@ -60,7 +60,14 @@ void Comm::Configure(const Config& cfg) {
   ring_mincount_ = cfg.GetSize("rabit_reduce_ring_mincount", 32 << 10);
   tree_minsize_ = cfg.GetSize("rabit_tree_reduce_minsize", 1 << 20);
   reduce_buffer_ = std::max<size_t>(cfg.GetSize("rabit_reduce_buffer", 256u << 20), 64);
-  tcp_no_delay_ = cfg.GetBool("rabit_enable_tcp_no_delay", false);
+  // Default ON (divergence from the reference's opt-in,
+  // allreduce_base.cc:205-210): the link protocol writes a small header
+  // then the payload, and with Nagle on the header segment stalls behind
+  // the peer's delayed ACK whenever the link direction is cold — measured
+  // 22ms vs 43us for a world-2 40KB tree allreduce on loopback.  Bulk
+  // chunk pipelining never benefits from Nagle coalescing anyway
+  // (transfers are >= chunk-sized writes).
+  tcp_no_delay_ = cfg.GetBool("rabit_enable_tcp_no_delay", true);
   bootstrap_timeout_sec_ =
       static_cast<double>(cfg.GetInt("rabit_bootstrap_timeout_sec", 60));
   // Hung-peer stall bound.  Engine-dependent default (default_stall_sec_,

@@ -126,7 +126,7 @@ class Comm {
   // links and re-enters the tracker as a recover wave, which converges
   // once the launcher restarts the dead worker (round-3 verdict item).
   double bootstrap_timeout_sec_ = 60.0;
-  bool tcp_no_delay_ = false;
+  bool tcp_no_delay_ = true;  // see Configure: Nagle stalls header writes
   bool initialized_ = false;
 };
 
