@@ -1,0 +1,16 @@
+#!/bin/bash
+# Regenerate the CPU-side evidence (RESULTS/*.jsonl) sequentially on a
+# quiet machine: concurrent runs poison each other on this single-core
+# container (round-3 lesson).  TPU-side evidence comes from
+# tools/tpu_watcher.sh / tools/hist_ablation.py instead.
+set -x
+cd "$(dirname "$0")/.." || exit 1
+python tools/speed_runner.py --json-out RESULTS/speed.jsonl
+python tools/consensus_bench.py --world 8   > RESULTS/.c8.jsonl
+python tools/consensus_bench.py --world 32  > RESULTS/.c32.jsonl
+python tools/consensus_bench.py --world 64 --iters 100 > RESULTS/.c64.jsonl
+python tools/consensus_bench.py --world 128 --iters 50 > RESULTS/.c128.jsonl
+cat RESULTS/.c8.jsonl RESULTS/.c32.jsonl RESULTS/.c64.jsonl \
+    RESULTS/.c128.jsonl > RESULTS/consensus.jsonl && rm -f RESULTS/.c*.jsonl
+python tools/recovery_bench.py 2 4 8 16 24 32 > RESULTS/recovery.jsonl
+echo DONE
