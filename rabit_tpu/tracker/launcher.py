@@ -24,6 +24,21 @@ import time
 from rabit_tpu.tracker.tracker import Tracker
 
 
+def cpu_worker_env() -> dict[str, str]:
+    """PYTHONPATH for spawned CPU-only workers: the repo root, with any
+    accelerator sitecustomize entries (e.g. the axon TPU shim) stripped.
+    A wedged TPU tunnel makes that sitecustomize burn ~2s of CPU at every
+    child interpreter boot, which poisons wall-clock benchmarks and slows
+    worker-spawning tests by minutes; workers that genuinely need the TPU
+    backend must keep their inherited PYTHONPATH instead."""
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    parts = [p for p in os.environ.get("PYTHONPATH", "").split(os.pathsep)
+             if p and "axon" not in p]
+    if repo not in parts:
+        parts.insert(0, repo)
+    return {"PYTHONPATH": os.pathsep.join(parts)}
+
+
 class LocalCluster:
     def __init__(
         self,

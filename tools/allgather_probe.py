@@ -1,0 +1,79 @@
+"""Isolate the flat ~44ms/op seen in the consensus TABLE path.
+
+The table exchange is the only user of small-payload Allgather; the speed
+bench (allreduce/broadcast) went to ~40us after TCP_NODELAY, yet the
+table path stayed at ~44ms across world sizes, rounds, and the NODELAY
+change.  This probe times small allgathers and allreduces side by side on
+the BASE engine (no consensus wrapping) so the stall can be attributed.
+
+    python tools/allgather_probe.py [--world 2] [--iters 50] [--bytes 32]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import tempfile
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO))
+
+WORKER_SRC = """
+import sys, time
+import numpy as np
+import rabit_tpu as rt
+
+iters = int(sys.argv[1])
+nbytes = int(sys.argv[2])
+rt.init()
+rank = rt.get_rank()
+x = np.zeros(max(nbytes // 8, 1), np.float64)
+rt.allreduce(x, rt.SUM)  # warm links
+rt.allgather(x)
+
+for name, fn in [
+    ("allreduce", lambda: rt.allreduce(x, rt.SUM)),
+    ("allgather", lambda: rt.allgather(x)),
+]:
+    ts = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        fn()
+        ts.append(time.perf_counter() - t0)
+    ts.sort()
+    if rank == 0:
+        rt.tracker_print(
+            f"{name}: median={ts[len(ts)//2]*1e3:.3f}ms "
+            f"p90={ts[int(len(ts)*0.9)]*1e3:.3f}ms max={ts[-1]*1e3:.3f}ms\\n")
+rt.finalize()
+"""
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--world", type=int, default=2)
+    ap.add_argument("--iters", type=int, default=50)
+    ap.add_argument("--bytes", type=int, default=32)
+    ap.add_argument("--engine", default="base")
+    args = ap.parse_args()
+
+    from rabit_tpu.tracker.launcher import LocalCluster, cpu_worker_env
+
+    with tempfile.TemporaryDirectory() as td:
+        worker = Path(td) / "worker.py"
+        worker.write_text(WORKER_SRC)
+        cluster = LocalCluster(args.world, quiet=True, extra_env=cpu_worker_env())
+        rc = cluster.run(
+            [sys.executable, str(worker), str(args.iters), str(args.bytes),
+             f"rabit_engine={args.engine}"],
+            timeout=300.0,
+        )
+        for m in cluster.messages:
+            print(m.strip())
+        return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

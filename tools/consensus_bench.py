@@ -17,7 +17,6 @@ from __future__ import annotations
 
 import argparse
 import json
-import os
 import subprocess
 import sys
 import tempfile
@@ -51,14 +50,13 @@ rt.finalize()
 
 
 def run_mode(world: int, iters: int, summary_on: bool) -> float:
-    from rabit_tpu.tracker.launcher import LocalCluster
+    from rabit_tpu.tracker.launcher import LocalCluster, cpu_worker_env
 
     with tempfile.TemporaryDirectory() as td:
         worker = Path(td) / "worker.py"
         worker.write_text(WORKER_SRC)
         out = Path(td) / "t.txt"
-        env = {"PYTHONPATH": f"{REPO}:{os.environ.get('PYTHONPATH', '')}"}
-        cluster = LocalCluster(world, quiet=True, extra_env=env)
+        cluster = LocalCluster(world, quiet=True, extra_env=cpu_worker_env())
         cmd = [
             sys.executable, str(worker), str(iters), str(out),
             "rabit_engine=native",

@@ -22,7 +22,7 @@ from pathlib import Path
 REPO = Path(__file__).resolve().parents[1]
 sys.path.insert(0, str(REPO))
 
-from rabit_tpu.tracker.launcher import LocalCluster  # noqa: E402
+from rabit_tpu.tracker.launcher import LocalCluster, cpu_worker_env  # noqa: E402
 
 WORKER = str(REPO / "tests" / "workers" / "recover_worker.py")
 
@@ -35,7 +35,8 @@ def run_once(world: int, extra: list[str], timeout: float | None = None):
     itself, without Python interpreter startup noise."""
     cmd = [sys.executable, WORKER, "rabit_engine=mock", "ndata=10000",
            "niter=3", *extra]
-    cluster = LocalCluster(world, max_restarts=5, quiet=True)
+    cluster = LocalCluster(world, max_restarts=5, quiet=True,
+                           extra_env=cpu_worker_env())
     t0 = time.perf_counter()
     if timeout is None:
         # Scale with world: on an oversubscribed host, wall time grows
@@ -54,6 +55,16 @@ def run_once(world: int, extra: list[str], timeout: float | None = None):
     ]
     if stamps and cluster.death_times:
         latency = min(stamps) - cluster.death_times[0]
+    # Kill -> first survivor notices (EOF cascade / stall timeout), the
+    # latency role the reference's unused OOB urgent-byte path targeted.
+    detect = None
+    detects = [
+        float(m.split("at=")[1].split()[0])
+        for m in cluster.messages
+        if "failure_detected" in m
+    ]
+    if detects and cluster.death_times:
+        detect = min(detects) - cluster.death_times[0]
     # Protocol-event counters from the restarted worker's LoadCheckPoint
     # (rabit_recover_stats=1): version>0 identifies the recovered life —
     # first lives print version=0.  Scheduling-independent, unlike wall
@@ -71,7 +82,7 @@ def run_once(world: int, extra: list[str], timeout: float | None = None):
             "serve_bytes": int(fields["serve_bytes"]),
         }
         break
-    return dt, latency, events
+    return dt, latency, events, detect
 
 
 def main() -> None:
@@ -85,6 +96,7 @@ def main() -> None:
         failure = min(f[0] for f in fails)
         lats = [f[1] for f in fails if f[1] is not None]
         events = next((f[2] for f in fails if f[2] is not None), None)
+        detects = [f[3] for f in fails if f[3] is not None]
         rec = {
             "world": world,
             "clean_s": round(clean, 3),
@@ -92,6 +104,7 @@ def main() -> None:
             "recovery_overhead_s": round(failure - clean, 3),
             "protocol_recovery_latency_s":
                 round(min(lats), 3) if lats else None,
+            "detect_latency_s": round(min(detects), 3) if detects else None,
         }
         if events is not None:
             rec.update(
