@@ -1,25 +1,31 @@
 #!/usr/bin/env bash
 # Regenerate every number in RESULTS.md (raw JSON into RESULTS/).
 #
-# CPU benches (always): collective sweep, recovery latency, consensus
-# fast-path, sklearn-anchored baseline.  Run them on an otherwise idle
-# machine — concurrent load pollutes the robust-engine rows.
+# CPU benches (always): collective sweep, consensus fast-path scaling,
+# recovery latency + protocol-event metrics, sklearn-anchored baseline.
+# Run them on an otherwise idle machine and strictly SEQUENTIALLY —
+# concurrent load pollutes the latency rows on this single-core container.
+# Worker processes spawn with a cleaned PYTHONPATH (cpu_worker_env): the
+# axon TPU sitecustomize costs ~2s per interpreter boot when the tunnel
+# is wedged, which would poison every wall-clock metric.
 #
-# TPU benches (pass --tpu; needs the real chip): histogram-kernel ablation.
-# The driver-bench number itself comes from `python bench.py`.
+# TPU benches (pass --tpu; needs the real chip): histogram-kernel ablation
+# incl. the bf16-vs-i8 table.  The driver-bench number itself comes from
+# `python bench.py`; tools/tpu_watcher.sh captures both as soon as a
+# wedged tunnel heals.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
 mkdir -p RESULTS
 
 python tools/speed_runner.py --json-out RESULTS/speed.jsonl
-# world 32 is recorded for the scale question but is pure scheduler noise
-# on this single-core container (see RESULTS.md §4) — takes ~3 min.
-python tools/recovery_bench.py 2 4 8 16 32 > RESULTS/recovery.jsonl
 {
-  python tools/consensus_bench.py --world 8 --iters 300
-  python tools/consensus_bench.py --world 32 --iters 150
+  python tools/consensus_bench.py --world 8 --iters 200
+  python tools/consensus_bench.py --world 32 --iters 200
+  python tools/consensus_bench.py --world 64 --iters 100
+  python tools/consensus_bench.py --world 128 --iters 50
 } > RESULTS/consensus.jsonl
+python tools/recovery_bench.py 2 4 8 16 24 32 > RESULTS/recovery.jsonl
 python tools/sklearn_baseline.py --json-out RESULTS/sklearn_baseline.json
 
 if [[ "${1:-}" == "--tpu" ]]; then
