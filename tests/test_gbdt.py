@@ -303,3 +303,49 @@ def test_train_round_dp_fused_matches_dp():
     np.testing.assert_array_equal(ff.feature, fr.feature)
     np.testing.assert_array_equal(ff.threshold, fr.threshold)
     np.testing.assert_allclose(ff.leaf, fr.leaf, rtol=1e-3, atol=1e-5)
+
+
+def test_train_round_dp_fused_wire_i8_close_to_exact():
+    """The int8-wire histogram allreduce (wire_i8) must grow trees whose
+    leaves match the exact-psum fused round to quantization tolerance —
+    and, with identical wire bytes decoded on every rank, identical split
+    tables (rank-consistent argmax)."""
+    from rabit_tpu.ops import boost
+
+    rng = np.random.RandomState(11)
+    ndev = 8
+    n, f = 128 * ndev, 4
+    cfg = gbdt.GBDTConfig(n_features=f, n_trees=2, depth=3, n_bins=16)
+    xb = jnp.asarray(rng.randint(0, cfg.n_bins, size=(n, f)), jnp.int32)
+    y = jnp.asarray(rng.randint(0, 2, size=n), jnp.float32)
+    mesh = rp.create_mesh(("dp",))
+    specs = dict(
+        in_specs=(
+            gbdt.TrainState(forest=gbdt.Forest(P(), P(), P()), margin=P("dp"), round=P()),
+            P("dp", None, None), P("dp"),
+        ),
+        out_specs=gbdt.TrainState(
+            forest=gbdt.Forest(P(), P(), P()), margin=P("dp"), round=P()
+        ),
+        check_vma=False,
+    )
+    xb3, _ = boost.block_rows(xb, 128)
+    exact = jax.shard_map(
+        functools.partial(gbdt.train_round_dp_fused, cfg=cfg, interpret=True),
+        mesh=mesh, **specs)
+    # flat level-0 hist = f * n_bins * 2 = 128 floats; 8 chunks of 16
+    wired = jax.shard_map(
+        functools.partial(gbdt.train_round_dp_fused, cfg=cfg, interpret=True,
+                          wire_i8=True, wire_block=16),
+        mesh=mesh, **specs)
+
+    s_e = gbdt.init_state(cfg, n)
+    s_w = gbdt.init_state(cfg, n)
+    for _ in range(cfg.n_trees):
+        s_e = exact(s_e, xb3, y)
+        s_w = wired(s_w, xb3, y)
+    fe = jax.tree.map(np.asarray, s_e.forest)
+    fw = jax.tree.map(np.asarray, s_w.forest)
+    np.testing.assert_array_equal(fw.feature, fe.feature)
+    np.testing.assert_array_equal(fw.threshold, fe.threshold)
+    np.testing.assert_allclose(fw.leaf, fe.leaf, rtol=1e-3, atol=1e-3)
