@@ -190,3 +190,21 @@ def test_reference_scale_10_workers_10k():
         timeout=240.0,
     )
     assert cluster.restarts[1] == 2  # die-hard: killed again on life 2
+
+
+def test_recover_stats_lines():
+    """rabit_recover_stats=1 emits the protocol-event evidence the
+    recovery bench consumes: a failure_detected stamp from a survivor and
+    the restarted worker's recover_stats counters at a nonzero version."""
+    cluster = run_cluster(
+        4, ["niter=3", "mock=1,1,1,0", "rabit_recover_stats=1"])
+    detected = [m for m in cluster.messages if "failure_detected at=" in m]
+    assert detected, f"no failure_detected line in {cluster.messages}"
+    stats = [
+        m for m in cluster.messages
+        if "recover_stats" in m and "version=0 " not in m
+    ]
+    assert stats, f"no recovered-life recover_stats line in {cluster.messages}"
+    fields = dict(kv.split("=") for kv in stats[0].split() if "=" in kv)
+    assert int(fields["summary_rounds"]) >= 1
+    assert int(fields["serve_bytes"]) > 0

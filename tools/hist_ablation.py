@@ -130,6 +130,36 @@ def main() -> int:
                       "level": d, "n_nodes_out": 1 << d,
                       "ms": round(dt * 1e3, 3)})
 
+        # Final-pass comparison: routing-only (round-3 shape, followed by a
+        # host-level leaf gather) vs the round-4 fused route+margin kernel.
+        n_prev = 1 << (args.depth - 1)
+        featd = jnp.asarray(
+            rng.randint(0, args.feats, size=n_prev), jnp.int32)
+        thrd = jnp.asarray(rng.randint(0, args.bins, size=n_prev), jnp.int32)
+        node3d = jnp.asarray(rng.randint(0, n_prev, size=g3.shape), jnp.int32)
+        leaf = jnp.asarray(rng.randn(1 << args.depth), jnp.float32)
+        f_route = jax.jit(functools.partial(boost.route_level,
+                                            depth=args.depth))
+        dt = timed(f_route, xb3, node3d, featd, thrd)
+        emit({"kernel": "route_level", "depth": args.depth,
+              "ms": round(dt * 1e3, 3)})
+
+        def route_then_gather(xb3_, node3_, feat_, thr_, leaf_):
+            n3 = boost.route_level(xb3_, node3_, feat_, thr_,
+                                   depth=args.depth)
+            node = boost.unblock_rows(n3, args.rows)
+            return leaf_[node]
+
+        dt = timed(jax.jit(route_then_gather), xb3, node3d, featd, thrd, leaf)
+        emit({"kernel": "route_level+leaf_gather", "depth": args.depth,
+              "ms": round(dt * 1e3, 3)})
+        m3 = jnp.zeros_like(g3)
+        f_rm = jax.jit(functools.partial(boost.route_margin_level,
+                                         depth=args.depth))
+        dt = timed(f_rm, xb3, node3d, m3, featd, thrd, leaf)
+        emit({"kernel": "route_margin_level", "depth": args.depth,
+              "ms": round(dt * 1e3, 3)})
+
         # Whole fused round, both MXU modes — ties the per-kernel numbers
         # to the headline rounds/s metric in one provenance-consistent run
         # (same plat gate as above: reuses xb3).
