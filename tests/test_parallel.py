@@ -149,6 +149,28 @@ def test_ring_allreduce_quantized_accuracy(mesh):
         assert rms < rel_rms * exact_rms, (planes, rms)
 
 
+def test_ring_allreduce_quantized_nonfinite_saturates(mesh):
+    """Non-finite inputs must not wrap the int8 residual plane (int8
+    astype wraps on overflow): with the planes clipped, an Inf/NaN block
+    decodes to a bounded (wrong, but finite-magnitude-of-scale) value and
+    every OTHER block still decodes to the exact envelope."""
+    rng = np.random.RandomState(7)
+    x = rng.randn(N, N * 256).astype(np.float32)
+    x[0, 5] = np.inf  # poison one element of rank 0's first block
+    f = shmap(
+        lambda v: rp.ring_allreduce_quantized(v[0], "dp")[None],
+        mesh, P("dp", None), P("dp", None),
+    )
+    out = np.asarray(f(x))
+    exact = x.sum(0)
+    # Blocks not containing the poisoned element stay within the envelope.
+    clean = np.ones_like(exact, bool)
+    clean[:256] = False  # the poisoned 256-element quantization block
+    scale = np.abs(x).sum(0)[clean].max()
+    assert np.all(np.isfinite(out[0][clean]))
+    assert np.max(np.abs(out[0][clean] - exact[clean])) <= scale * (N + 1) / 128
+
+
 def test_ring_allreduce_quantized_rejects_ragged_block(mesh):
     x = np.ones((N, N * 3), np.float32)  # chunk 3 elems: not block-divisible
     f = shmap(
