@@ -136,44 +136,57 @@ void Comm::Init(bool recover) {
     listen_.Create();
     listen_port_ = listen_.BindListen();
   }
-  for (;;) {
+  // Bounded re-wave loop: a failed wave is retried against the tracker at
+  // most rabit_bootstrap_retries times, then the last failure propagates.
+  // The bound matters for the NON-robust engines (no watchdog): without
+  // it, a deterministic BuildLinks failure (bad peer table, a dead peer
+  // that no launcher will ever restart) would loop against the tracker
+  // forever instead of dying with an error a supervisor can observe.
+  const int max_waves =
+      std::max<int>(1, static_cast<int>(cfg_.GetInt("rabit_bootstrap_retries", 10)));
+  for (int wave = 1;; ++wave) {
     TcpSocket tr;
     ConnectTracker(&tr);
     SendHello(&tr, recover ? kCmdRecover : kCmdStart);
     RecvAssignment(&tr);
     tr.Close();
     bool ok = false;
+    std::string err;
     try {
       ok = BuildLinks();
     } catch (const Error& e) {
+      err = e.what();
       fprintf(stderr, "[rank %d] bootstrap epoch %d failed: %s\n", rank_,
-              epoch_, e.what());
+              epoch_, err.c_str());
     }
     if (ok) break;
+    CloseLinks();
+    if (wave >= max_waves) {
+      throw Error(Format(
+          "bootstrap failed after %d waves (rank %d, epoch %d)%s%s",
+          wave, rank_, epoch_, err.empty() ? "" : ": ", err.c_str()));
+    }
     // A peer assigned in this wave died before its links came up (the
     // initial-bootstrap liveness hole: a worker killed between tracker
     // check-in and peer dial would otherwise strand its accept-side peers
-    // forever).  Close partial links and re-enter the tracker as a
-    // recover wave: every stranded survivor times out the same way, the
-    // launcher restarts the dead worker, and the next wave's fresh epoch
-    // completes.  The robust engine's watchdog bounds total time here.
-    CloseLinks();
+    // forever).  Re-enter the tracker as a recover wave: every stranded
+    // survivor times out the same way, the launcher restarts the dead
+    // worker, and the next wave's fresh epoch completes.  The robust
+    // engine's watchdog additionally bounds total time here.
     recover = true;
     fprintf(stderr,
             "[rank %d] re-entering tracker after incomplete bootstrap "
-            "(epoch %d)\n",
-            rank_, epoch_);
+            "(epoch %d, wave %d/%d)\n",
+            rank_, epoch_, wave, max_waves);
   }
   initialized_ = true;
 }
 
 bool Comm::BuildLinks() {
   CloseLinks();
-  const double deadline =
-      bootstrap_timeout_sec_ > 0 ? NowSec() + bootstrap_timeout_sec_ : 0;
-  auto remaining = [&]() {
-    return deadline == 0 ? 3600.0 : deadline - NowSec();
-  };
+  const bool bounded = bootstrap_timeout_sec_ > 0;
+  const double deadline = bounded ? NowSec() + bootstrap_timeout_sec_ : 0;
+  auto remaining = [&]() { return deadline - NowSec(); };
   std::set<int> neighbors;
   if (parent_ >= 0) neighbors.insert(parent_);
   for (int c : children_) neighbors.insert(c);
@@ -209,7 +222,11 @@ bool Comm::BuildLinks() {
     }
   }
   while (expect_accept > 0) {
-    if (remaining() <= 0 || !listen_.WaitAcceptable(remaining())) {
+    if (!bounded) {
+      // rabit_bootstrap_timeout_sec=0: wait forever, as documented.
+      while (!listen_.WaitAcceptable(3600.0)) {
+      }
+    } else if (remaining() <= 0 || !listen_.WaitAcceptable(remaining())) {
       fprintf(stderr,
               "[rank %d] bootstrap: %d expected link(s) never arrived "
               "within %.0fs\n",
@@ -219,7 +236,8 @@ bool Comm::BuildLinks() {
     TcpSocket s = listen_.Accept();
     // Bound the hello read too: a dialer that connected and then died
     // sends nothing, and an unbounded RecvAll would re-open the hole.
-    s.SetRecvTimeout(std::max(remaining(), 1.0));
+    // (Unbounded mode keeps it unbounded, consistent with its contract.)
+    s.SetRecvTimeout(bounded ? std::max(remaining(), 1.0) : 0.0);
     uint32_t hello[3];
     try {
       s.RecvAll(hello, sizeof(hello));

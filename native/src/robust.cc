@@ -26,6 +26,7 @@
 // until the whole world is at the same (version, seqno), then everyone runs
 // the op live together (the reference's "all-same-seqno & no flags => you
 // run it", allreduce_robust.cc:1299-1302).
+#include <sys/time.h>
 #include <unistd.h>
 
 #include <algorithm>
@@ -354,7 +355,25 @@ class RobustEngine : public Engine {
   // --- failure handling ---------------------------------------------------
 
   void CheckAndRecover() {
+    // Arm FIRST: everything below (including the best-effort stats print,
+    // which opens a fresh tracker connection) must sit under the hang
+    // bound this watchdog exists to provide.
     watchdog_.Arm(timeout_sec_, comm_.rank());
+    if (recover_stats_) {
+      // Epoch-clock stamp (same clock as the launcher's death_times and
+      // the workers' recovered_at): lets the bench measure the
+      // kill -> survivor-notices cascade — the latency role the
+      // reference's (unused) OOB urgent-byte signal was meant to play.
+      timeval tv{};
+      gettimeofday(&tv, nullptr);
+      try {
+        comm_.TrackerPrint(Format(
+            "[%d] failure_detected at=%.6f\n", comm_.rank(),
+            static_cast<double>(tv.tv_sec) + 1e-6 * tv.tv_usec));
+      } catch (const Error&) {
+        // tracker unreachable mid-recovery: stats are best-effort
+      }
+    }
     comm_.CloseLinks();
     // Stagger tracker reconnects slightly (reference stampede control,
     // allreduce_robust.cc:722).

@@ -33,7 +33,7 @@ def cpu_worker_env() -> dict[str, str]:
     backend must keep their inherited PYTHONPATH instead."""
     repo = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     parts = [p for p in os.environ.get("PYTHONPATH", "").split(os.pathsep)
-             if p and "axon" not in p]
+             if p and os.path.basename(p.rstrip("/")) != ".axon_site"]
     if repo not in parts:
         parts.insert(0, repo)
     return {"PYTHONPATH": os.pathsep.join(parts)}

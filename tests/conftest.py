@@ -18,7 +18,8 @@ force_cpu_platform(8)
 # 0.02s for `python -c pass` — which both slows the suite by minutes and
 # poisons every wall-clock assertion/benchmark that spawns workers.
 _pp = os.environ.get("PYTHONPATH", "")
-_parts = [p for p in _pp.split(os.pathsep) if p and "axon" not in p]
+_parts = [p for p in _pp.split(os.pathsep)
+          if p and os.path.basename(p.rstrip("/")) != ".axon_site"]
 _repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if _repo not in _parts:
     _parts.insert(0, _repo)
