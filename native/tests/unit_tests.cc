@@ -166,6 +166,44 @@ TEST(stall_timeout_reports_peer_failure) {
   (void)b;
 }
 
+// --- bounded-bootstrap primitives (round-4 liveness fix: a worker dead
+// between tracker check-in and dialing must not strand accept-side
+// peers; comm.cc BuildLinks builds on these two) -------------------------
+
+TEST(wait_acceptable_times_out_and_detects_dialer) {
+  TcpSocket lst;
+  lst.Create();
+  int port = lst.BindListen();
+  double t0 = NowSec();
+  CHECK_TRUE(!lst.WaitAcceptable(0.1));  // nobody dialing: bounded wait
+  double dt = NowSec() - t0;
+  CHECK_TRUE(dt >= 0.09 && dt < 5.0);
+  TcpSocket dialer;
+  dialer.Connect("127.0.0.1", port);
+  CHECK_TRUE(lst.WaitAcceptable(5.0));   // pending connection: immediate
+  TcpSocket s = lst.Accept();
+  CHECK_TRUE(s.valid());
+}
+
+TEST(recv_timeout_bounds_silent_peer) {
+  int sv[2];
+  CHECK_TRUE(socketpair(AF_UNIX, SOCK_STREAM, 0, sv) == 0);
+  TcpSocket a(sv[0]), b(sv[1]);
+  a.SetRecvTimeout(0.1);
+  char hello[12];
+  bool threw = false;
+  double t0 = NowSec();
+  try {
+    a.RecvAll(hello, sizeof(hello));  // dialer connected, then died silent
+  } catch (const Error&) {
+    threw = true;
+  }
+  double dt = NowSec() - t0;
+  CHECK_TRUE(threw);
+  CHECK_TRUE(dt >= 0.09 && dt < 5.0);
+  (void)b;
+}
+
 TEST(stall_timeout_progress_resets_nothing_but_completes) {
   int sv[2];
   CHECK_TRUE(socketpair(AF_UNIX, SOCK_STREAM, 0, sv) == 0);
