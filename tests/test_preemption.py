@@ -57,3 +57,19 @@ def test_preempt_repeated_same_rank():
     cluster = run_with_preempts([(1.0, 2), (3.0, 2)])
     assert cluster.preempts_delivered == 2
     assert cluster.restarts[2] >= 2
+
+
+def test_preempt_during_bootstrap_window():
+    """A kill landing in the startup/bootstrap window (before the first
+    collective) must not strand the survivors: the round-4 bounded
+    bootstrap re-waves them and the restarted worker completes the job.
+    Complements test_bootstrap_liveness's deterministic injection with a
+    stochastic external SIGKILL."""
+    cmd = [sys.executable, WORKER, *ARGS,
+           "rabit_bootstrap_timeout_sec=2"]
+    cluster = LocalCluster(4, max_restarts=10, quiet=True)
+    rc = cluster.run(cmd, timeout=240.0, preempt=[(0.05, 2)])
+    assert rc == 0
+    assert all(r == 0 for r in cluster.returncodes)
+    assert cluster.preempts_delivered == 1
+    assert cluster.restarts[2] >= 1
