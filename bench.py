@@ -299,6 +299,29 @@ def try_tpu_within_budget():
     return None
 
 
+def parked_tpu_capture():
+    """A previously captured on-chip driver-bench line, if one exists.
+
+    tools/tpu_watcher.sh promotes RESULTS/bench_watch.json only when it
+    holds a platform:"tpu" measurement.  When the live run cannot reach
+    the chip (wedged tunnel), the fallback line carries that capture —
+    same code, same metric, clearly labelled with its capture time — so
+    the recorded artifact points at the real TPU evidence instead of
+    silently erasing it (round-3 failure mode)."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "RESULTS", "bench_watch.json")
+    try:
+        with open(path) as f:
+            cap = json.loads(f.read().strip().splitlines()[-1])
+        if cap.get("platform") == "tpu":
+            cap["captured_at"] = time.strftime(
+                "%Y-%m-%d %H:%M:%S", time.gmtime(os.path.getmtime(path)))
+            return cap
+    except (OSError, ValueError, IndexError):
+        pass
+    return None
+
+
 def main():
     log(f"dataset: {N_ROWS} rows x {N_FEATURES} feats, {N_BINS} bins, depth {DEPTH}")
     # Numpy baseline FIRST: it is a ~2s subsample-and-scale measurement, and
@@ -318,7 +341,7 @@ def main():
     if not isinstance(res, dict):
         # Last resort: numpy-only numbers, so the driver still gets a line.
         log("device bench unavailable; reporting numpy-only baseline")
-        print(json.dumps({
+        rec = {
             "metric": "gbdt_hist_rounds_per_sec_1M_rows",
             "value": round(1.0 / baseline_1m, 3),
             "unit": "rounds/s",
@@ -326,7 +349,11 @@ def main():
             "platform": "numpy-fallback",
             "rows_measured": N_ROWS,
             "wall_s": round(time.time() - T_START, 1),
-        }), flush=True)
+        }
+        cap = parked_tpu_capture()
+        if cap is not None:
+            rec["last_tpu_capture"] = cap
+        print(json.dumps(rec), flush=True)
         return
     device_time = res["device_time"]
     log(f"device per-round: {device_time * 1e3:.1f} ms on {res['platform']}")
@@ -343,7 +370,7 @@ def main():
     # rows) instead of reporting an inflated small-problem rate under the
     # 1M-row metric name.  vs_baseline is a same-size ratio: no rescale.
     scale = N_ROWS / n_rows
-    print(json.dumps({
+    rec = {
         "metric": "gbdt_hist_rounds_per_sec_1M_rows",
         "value": round(1.0 / (device_time * scale), 3),
         "unit": "rounds/s",
@@ -352,7 +379,12 @@ def main():
         "mxu": res.get("mxu", "bf16"),
         "rows_measured": n_rows,
         "wall_s": round(time.time() - T_START, 1),
-    }), flush=True)
+    }
+    if res["platform"] != "tpu":
+        cap = parked_tpu_capture()
+        if cap is not None:
+            rec["last_tpu_capture"] = cap
+    print(json.dumps(rec), flush=True)
 
 
 if __name__ == "__main__":
