@@ -1,0 +1,90 @@
+#!/bin/bash
+# Keep tools/tpu_watcher.sh provably alive (VERDICT round-4 weak #2: an
+# unnoticed watcher death silently forfeits the only path to on-chip
+# evidence).
+#   - flock singleton guard: a second supervisor exits immediately.
+#   - Watcher liveness is the watcher's OWN flock on RESULTS/.watcher.lock
+#     — exact, immune to pid reuse, and a manually-started watcher counts
+#     as alive instead of tripping a phantom crash loop.
+#   - Exit condition is the RESULTS/.captures_done sentinel, which lists
+#     the artifact paths it vouches for; at startup a sentinel whose
+#     artifacts are gone is stale state from a prior round and is removed,
+#     while one whose artifacts exist means work is already complete.
+#   - Restarts are rate-limited with backoff; the counter resets once a
+#     watcher stays alive 30 min, so occasional deaths in a long healthy
+#     run aren't punished like a crash loop.  Watcher stderr goes to the
+#     log so a startup crash is diagnosable; the lock fd is closed in the
+#     child so the watcher can't pin a dead supervisor's lock.
+# Emits its own hourly heartbeat: the log carries TWO independent
+# liveness signals.  Log: RESULTS/tpu_watch.log
+cd "$(dirname "$0")/.." || exit 1
+LOG=RESULTS/tpu_watch.log
+
+exec 8>RESULTS/.super.lock
+if ! flock -n 8; then
+  echo "[super $(date +%T)] another supervisor holds the lock; exiting (pid $$)" >> "$LOG"
+  exit 0
+fi
+
+sentinel_ok() {  # every "path<TAB>pattern" line still greps true
+  [ -s RESULTS/.captures_done ] || return 1
+  while IFS=$'\t' read -r f pat; do
+    [ -s "$f" ] && grep -q "$pat" "$f" || return 1
+  done < RESULTS/.captures_done
+  return 0
+}
+
+if [ -e RESULTS/.captures_done ]; then
+  if sentinel_ok; then
+    echo "[super $(date +%T)] captures already complete (sentinel verified); exiting" >> "$LOG"
+    exit 0
+  fi
+  echo "[super $(date +%T)] removing stale captures-done sentinel (evidence missing); new round" >> "$LOG"
+  rm -f RESULTS/.captures_done RESULTS/.probe_count
+fi
+echo "[super $(date +%T)] supervisor start (pid $$)" >> "$LOG"
+
+watcher_alive() {
+  # The watcher holds an exclusive flock on RESULTS/.watcher.lock for its
+  # whole life; if we can grab it, no watcher (ours or anyone's) is alive.
+  ! flock -n RESULTS/.watcher.lock true 2>/dev/null
+}
+
+WPID=""
+LAST_RESTART=0
+RESTARTS=0
+LAST_BEAT=$(date +%s)
+while true; do
+  if [ -e RESULTS/.captures_done ]; then
+    echo "[super $(date +%T)] captures-done sentinel present; supervisor exiting" >> "$LOG"
+    exit 0
+  fi
+  if watcher_alive; then
+    if [ "$RESTARTS" -gt 0 ] && [ $(($(date +%s) - LAST_RESTART)) -ge 1800 ]; then
+      RESTARTS=0
+    fi
+    BACKOFF=60
+  else
+    RESTARTS=$((RESTARTS + 1))
+    if [ "$RESTARTS" -gt 50 ]; then
+      echo "[super $(date +%T)] watcher crash-looped $RESTARTS times; giving up (inspect log above)" >> "$LOG"
+      exit 1
+    fi
+    echo "[super $(date +%T)] watcher not running — starting it (restart #$RESTARTS)" >> "$LOG"
+    nohup bash tools/tpu_watcher.sh >/dev/null 2>>"$LOG" 8>&- &
+    WPID=$!
+    LAST_RESTART=$(date +%s)
+    disown
+    # Backoff grows with consecutive fast deaths so a crash-looping
+    # watcher can't spam the log: 60s, 120s, ..., capped at 10 min.
+    BACKOFF=$((RESTARTS * 60)); [ "$BACKOFF" -gt 600 ] && BACKOFF=600
+  fi
+  NOW=$(date +%s)
+  if [ $((NOW - LAST_BEAT)) -ge 3600 ]; then
+    echo "[super $(date +%T)] heartbeat: supervisor alive, last-spawned watcher pid ${WPID:-none}" >> "$LOG"
+    LAST_BEAT=$NOW
+  fi
+  # fd 8 closed so a kill mid-sleep can't leave an orphan sleep pinning
+  # the supervisor lock past the death.
+  sleep "$BACKOFF" 8>&-
+done
