@@ -21,6 +21,7 @@
 #include <sys/time.h>
 #include <unistd.h>
 
+#include <climits>
 #include <cstdint>
 #include <string>
 #include <utility>
@@ -92,8 +93,17 @@ class TcpSocket {
   // allreduce_robust.cc:693-716).
   bool WaitAcceptable(double sec) const {
     pollfd pfd{fd_, POLLIN, 0};
-    int ms = sec <= 0 ? 0 : static_cast<int>(sec * 1e3) + 1;
+    // Deadline-based so a stream of EINTRs cannot extend the bound, and
+    // clamped so huge configured timeouts don't overflow into a negative
+    // (infinite) poll timeout.
+    double deadline = NowSec() + (sec > 0 ? sec : 0);
     for (;;) {
+      double left = deadline - NowSec();
+      if (left < 0) left = 0;
+      double ms_d = left * 1e3 + 1;
+      int ms = ms_d > static_cast<double>(INT_MAX)
+                   ? INT_MAX
+                   : static_cast<int>(ms_d);
       int r = ::poll(&pfd, 1, ms);
       if (r < 0 && errno == EINTR) continue;
       TRT_CHECK(r >= 0, "poll on listen socket: %s", strerror(errno));

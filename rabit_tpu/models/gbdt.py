@@ -442,12 +442,15 @@ def train_round_dp_fused(state, xb3, y, cfg, dp_axis: str = "dp",
     quantized int8-wire ring (parallel.ring_allreduce_quantized, ~2x fewer
     ICI/DCN bytes at ~2^-16-of-block-max accuracy per hop) instead of
     ``lax.psum`` — the bandwidth-bound-regime option for large
-    feature x bin spaces or DCN-crossing dp axes.  Lossy but
-    rank-consistent: every rank decodes identical wire bytes, so split
-    decisions stay globally consistent (agreement is to f32 rounding, not
-    bitwise — keep exact psum where the replay contract needs
-    byte-identical results).  Requires the flattened per-level histogram
-    (2^d * F * n_bins * 2 floats) divisible by dp_size * wire_block."""
+    feature x bin spaces or DCN-crossing dp axes.  Lossy but structurally
+    rank-consistent: every rank (owner included) decodes each chunk's
+    identical wire bytes at the identical program point, so the reduced
+    histograms — and hence best_splits argmax decisions, even on exact
+    ties — are bitwise identical across ranks; the forests cannot
+    silently diverge.  Keep exact psum where results must also be
+    byte-identical to a serial replay (the robust replay contract).
+    Requires the flattened per-level histogram (2^d * F * n_bins * 2
+    floats) divisible by dp_size * wire_block."""
     if wire_i8:
         from rabit_tpu.parallel import ring_allreduce_quantized
 

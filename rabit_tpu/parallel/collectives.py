@@ -174,11 +174,16 @@ def ring_allreduce_quantized(x: Array, axis_name: str, *,
     allgather phase quantizes each owner's final chunk ONCE and forwards
     the identical payload, adding a single quantization.
 
-    LOSSY and opt-in: paths with bit-exactness guarantees (the robust
-    replay contract, hybrid byte-identical recovery) must keep the exact
-    collectives.  Every rank decodes identical wire bits, but compiler
-    fusion may round the owner's local decode differently from a
-    receiver's, so copies agree to f32 rounding (~1 ulp), not bitwise.
+    LOSSY but rank-consistent: the value of chunk j on every rank is the
+    decode of owner j's single int8+scale payload, and every decode —
+    including the owner's own — runs at the SAME program point (one
+    write-then-hop loop body, identical on all ranks under SPMD), so the
+    allreduce output is bitwise identical across ranks.  Downstream
+    argmax-style decisions (e.g. GBDT split selection) therefore cannot
+    diverge between ranks even on exact ties.  Still opt-in: paths with
+    bit-exactness guarantees vs a SERIAL replay (the robust replay
+    contract, hybrid byte-identical recovery) must keep the exact
+    collectives — lossy means the value differs from an unquantized psum.
     f32 input, leading dim divisible by the axis size, chunk elements
     divisible by ``block``."""
     if planes not in (1, 2):
@@ -213,20 +218,27 @@ def ring_allreduce_quantized(x: Array, axis_name: str, *,
     owned = lax.fori_loop(0, n - 1, rs_body, init)
 
     # Allgather: ONE quantization per owner; the int8 payload is forwarded
-    # verbatim so hops add no further error.
+    # verbatim so hops add no further error.  Write-then-hop for n steps —
+    # the owner's own chunk goes through the same in-loop decode as every
+    # received chunk, which is what makes the output bitwise identical
+    # across ranks (an out-of-loop owner decode is a differently-fused
+    # code path that may round differently; ADVICE r4).  Costs one
+    # payload-rotating hop beyond the minimal n-1.
     q0, s0 = _quantize_i8(owned.reshape(-1), block, planes)
-    out = jnp.zeros((n, csize), jnp.float32)
-    out = lax.dynamic_update_index_in_dim(
-        out, _dequantize_i8(q0, s0), idx, 0)
+    # The zeros carry must enter the loop already marked varying over the
+    # mesh axis (each rank fills it with different chunks) or the loop
+    # body's first update changes its vma type and tracing rejects it.
+    out = lax.pcast(jnp.zeros((n, csize), jnp.float32),
+                    (axis_name,), to="varying")
 
     def ag_body(s, carry):
         out, q, sc = carry
-        q, sc = lax.ppermute((q, sc), axis_name, perm)
         out = lax.dynamic_update_index_in_dim(
-            out, _dequantize_i8(q, sc), (idx - s - 1) % n, 0)
+            out, _dequantize_i8(q, sc), (idx - s) % n, 0)
+        q, sc = lax.ppermute((q, sc), axis_name, perm)
         return out, q, sc
 
-    out, _, _ = lax.fori_loop(0, n - 1, ag_body, (out, q0, s0))
+    out, _, _ = lax.fori_loop(0, n, ag_body, (out, q0, s0))
     return out.reshape(x.shape)
 
 

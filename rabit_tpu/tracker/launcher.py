@@ -96,6 +96,7 @@ class LocalCluster:
         start = time.monotonic()
         deadline = start + timeout
         pending = sorted(preempt or [], key=lambda p: p[0], reverse=True)
+        reap_pending: set[int] = set()  # killed, reap deferred to poll loop
         try:
             while True:
                 if time.monotonic() > deadline:
@@ -115,13 +116,17 @@ class LocalCluster:
                     # kill() on a child that exited between the poll()
                     # above and here is a silent no-op; only count the
                     # preemption as delivered when the reaped status shows
-                    # the SIGKILL actually landed (returncode -9).
+                    # the SIGKILL actually landed (returncode -9).  The
+                    # wait here is deliberately short so a slow-to-reap
+                    # child can't stall other scheduled preemptions or the
+                    # deadline check; a pending reap is counted later from
+                    # the poll loop's observed returncode.
                     try:
-                        rc = proc.wait(timeout=5)
+                        rc = proc.wait(timeout=0.5)
+                        if rc == -signal.SIGKILL:
+                            self.preempts_delivered += 1
                     except subprocess.TimeoutExpired:
-                        rc = -signal.SIGKILL  # kill sent, reap pending
-                    if rc == -signal.SIGKILL:
-                        self.preempts_delivered += 1
+                        reap_pending.add(idx)
                     if not self.quiet:
                         print(f"[launcher] preempted worker {idx} "
                               f"(SIGKILL)", flush=True)
@@ -130,6 +135,10 @@ class LocalCluster:
                     if proc is None:
                         continue
                     ret = proc.poll()
+                    if ret is not None and i in reap_pending:
+                        reap_pending.discard(i)
+                        if ret == -signal.SIGKILL:
+                            self.preempts_delivered += 1
                     if ret is None:
                         alive += 1
                     elif ret == 0:

@@ -140,9 +140,10 @@ def test_ring_allreduce_quantized_accuracy(mesh):
         )
         out = np.asarray(f(x))
         for i in range(N):
-            # identical wire bits; decode rounding may differ by ~1 ulp
-            # between the owner and receivers (compiler fusion)
-            np.testing.assert_allclose(out[i], out[0], atol=4e-6, rtol=0)
+            # identical wire bits decoded at the identical program point
+            # on every rank (owner included): agreement is BITWISE, the
+            # structural guarantee split-argmax consistency rides on
+            np.testing.assert_array_equal(out[i], out[0])
         err = np.max(np.abs(out[0] - exact))
         assert err <= scale * (N + 1) / 128, (planes, err, scale)
         rms = np.sqrt(np.mean((out[0] - exact) ** 2))
