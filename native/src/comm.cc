@@ -490,6 +490,7 @@ IoResult Comm::RingExchange(const void* send, size_t send_bytes, void* recv,
 IoResult Comm::Allgather(const void* mine, size_t slice_bytes, void* out) {
   char* obuf = static_cast<char*>(out);
   memcpy(obuf + static_cast<size_t>(rank_) * slice_bytes, mine, slice_bytes);
+  last_allgather_hops_ = 0;
   if (world_ <= 1 || slice_bytes == 0) return IoResult::kOk;
   const int n = world_;
   // Circulate slices around the ring: step s sends slice (rank-s),
@@ -502,6 +503,7 @@ IoResult Comm::Allgather(const void* mine, size_t slice_bytes, void* out) {
                               obuf + static_cast<size_t>(rc) * slice_bytes,
                               slice_bytes);
     if (r != IoResult::kOk) return r;
+    ++last_allgather_hops_;
   }
   return IoResult::kOk;
 }

@@ -81,6 +81,11 @@ class Comm {
   IoResult AllreduceRing(char* buf, size_t elem_size, size_t count,
                          ReduceFn fn, void* ctx);
 
+  // Serial ring hops the last Allgather actually executed (world-1 when it
+  // completed) — the measured O(W) term the consensus-depth metrics report
+  // against the summary path's O(log W) merge depth (round-5 verdict #4).
+  uint64_t last_allgather_hops() const { return last_allgather_hops_; }
+
  private:
   void ConnectTracker(TcpSocket* sock) const;
   void SendHello(TcpSocket* sock, uint32_t cmd) const;
@@ -127,6 +132,7 @@ class Comm {
   // once the launcher restarts the dead worker (round-3 verdict item).
   double bootstrap_timeout_sec_ = 60.0;
   bool tcp_no_delay_ = true;  // see Configure: Nagle stalls header writes
+  uint64_t last_allgather_hops_ = 0;
   bool initialized_ = false;
 };
 

@@ -78,6 +78,14 @@ class CollectiveStats:
 GLOBAL_STATS = CollectiveStats()
 
 
+def parse_stats_line(line: str) -> dict[str, str]:
+    """Parse a ``key=value``-style tracker line (the robust engine's
+    ``recover_stats`` / ``recover_stats_final`` observability prints) into a
+    dict.  One parser for every consumer (recovery/consensus benches, tests)
+    so a stats-line format change has a single point of truth."""
+    return dict(p.split("=", 1) for p in line.split() if "=" in p)
+
+
 @contextlib.contextmanager
 def xla_trace(logdir: str):
     """Capture an XLA device trace for TensorBoard/xprof — the TPU-native

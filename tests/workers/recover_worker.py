@@ -20,6 +20,13 @@ Worker args (k=v on the command line, all also forwarded to the engine):
                    machine-independent minimum duration so timed external
                    preemptions (tests/test_preemption.py) reliably land
                    mid-work on hosts of any speed
+    blob_mb=F      carry an F-MiB byte blob inside the global model, with
+                   closed-form content per version so a recovered blob is
+                   verified byte-for-byte — sizes the checkpoint-serve path
+                   like a real forest/model (tools/recovery_bench.py
+                   --blob-mb; the reference streams recovery through its
+                   chunked data loops for exactly this regime,
+                   allreduce_robust.cc:861-973)
     stop_at=K      every worker exits cleanly right after checkpoint K —
                    simulates a whole-job preemption for the durable-spill
                    resume tests (pair with rabit_checkpoint_dir=...)
@@ -53,7 +60,13 @@ def check(cond: bool, what: str) -> None:
 def main() -> int:
     ndata = int(getarg("ndata", "100"))
     niter = int(getarg("niter", "3"))
+    blob_mb = float(getarg("blob_mb", "0"))
     pause = float(getarg("sleep", "0"))
+
+    def blob_for(ver: int) -> bytes:
+        # Deterministic per-version content: recovery must reproduce the
+        # exact bytes, so a truncated/corrupted serve cannot pass.
+        return bytes([ver & 0xFF]) * int(blob_mb * (1 << 20))
     stop_at = int(getarg("stop_at", "0"))
     use_local = getarg("local", "0") == "1"
     use_lazy = getarg("lazy", "0") == "1"
@@ -78,7 +91,10 @@ def main() -> int:
     if version == 0:
         model = {"iter": 0, "history": []}
         lmodel = {"rank": rank, "iter": 0}
-    check(model["iter"] == version, f"model {model} vs version {version}")
+    check(model["iter"] == version, f"model vs version {version}")
+    if blob_mb and version > 0:
+        check(model.get("blob") == blob_for(version),
+              f"blob mismatch at version {version}")
     if use_local:
         check(lmodel["rank"] == rank, f"local model {lmodel} not mine")
     if int(os.environ.get("DMLC_NUM_ATTEMPT", "0")) > 0:
@@ -128,6 +144,8 @@ def main() -> int:
         # in-place mutation here would be served as stale bytes of the old
         # version (same window as the reference's global_lazycheck).
         model = {"iter": it + 1, "history": model["history"] + [it]}
+        if blob_mb:
+            model["blob"] = blob_for(it + 1)
         if use_local:
             lmodel = {"rank": rank, "iter": it + 1}
             rt.checkpoint(model, lmodel)

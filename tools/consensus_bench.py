@@ -2,7 +2,7 @@
 
 Every robust collective opens with a consensus round.  Round-2's protocol
 ring-allgathered the full PeerState table (world-1 serial hops per op);
-round 3 added a tree-allreduced 40-byte Summary fast path (reference
+round 3 added a tree-allreduced 44-byte Summary fast path (reference
 ActionSummary analogue, allreduce_robust.h:224-322) with the table exchange
 only on divergence.  This tool measures tiny-payload robust allreduce
 latency with the fast path on (rabit_consensus_summary=1, default) and
@@ -49,7 +49,7 @@ rt.finalize()
 """
 
 
-def run_mode(world: int, iters: int, summary_on: bool) -> float:
+def run_mode(world: int, iters: int, summary_on: bool) -> tuple[float, dict]:
     from rabit_tpu.tracker.launcher import LocalCluster, cpu_worker_env
 
     with tempfile.TemporaryDirectory() as td:
@@ -59,12 +59,31 @@ def run_mode(world: int, iters: int, summary_on: bool) -> float:
         cluster = LocalCluster(world, quiet=True, extra_env=cpu_worker_env())
         cmd = [
             sys.executable, str(worker), str(iters), str(out),
-            "rabit_engine=native",
+            "rabit_engine=native", "rabit_recover_stats=1",
             f"rabit_consensus_summary={int(summary_on)}",
         ]
-        rc = cluster.run(cmd, timeout=600.0)
+        rc = cluster.run(cmd, timeout=1200.0)
         assert rc == 0, f"cluster failed rc={rc}"
-        return float(out.read_text())
+        # Protocol-structure counters from rank 0's shutdown line: per-op
+        # critical-path depth, the scheduling-independent O(log W) vs O(W)
+        # exhibit (wall clocks at oversubscribed worlds measure the
+        # scheduler, these measure the protocol).
+        from rabit_tpu.profile import parse_stats_line
+
+        stats: dict = {}
+        for m in cluster.messages:
+            if "recover_stats_final" in m and m.startswith("[0]"):
+                kv = parse_stats_line(m)
+                sr = int(kv.get("summary_rounds", 0))
+                tr = int(kv.get("table_rounds", 0))
+                if sr:
+                    stats["depth_per_summary"] = round(
+                        int(kv["summary_depth"]) / sr, 2)
+                if tr:
+                    stats["hops_per_table"] = round(
+                        int(kv["table_hops"]) / tr, 2)
+                break
+        return float(out.read_text()), stats
 
 
 def main() -> None:
@@ -74,7 +93,7 @@ def main() -> None:
     args = ap.parse_args()
     results = {}
     for on in (True, False):
-        per_op = run_mode(args.world, args.iters, on)
+        per_op, stats = run_mode(args.world, args.iters, on)
         mode = "summary_ologw" if on else "table_ow"
         results[mode] = per_op
         print(json.dumps({
@@ -83,6 +102,7 @@ def main() -> None:
             "world": args.world,
             "iters": args.iters,
             "per_op_ms": round(per_op * 1e3, 3),
+            **stats,
         }), flush=True)
     print(json.dumps({
         "bench": "consensus_healthy_path",
