@@ -35,8 +35,21 @@ case "$PROBES" in ''|*[!0-9]*) PROBES=0;; esac
 echo "[watch $(date +%T)] watcher start (pid $$, $PROBES probes carried over)" >> "$LOG"
 
 bench_running() {
-  # A foreground process (the driver, or a manual run) is using the chip.
-  pgrep -f "bench\.py" >/dev/null 2>&1
+  # A foreground bench (driver bench.py, or the CPU bench tools whose
+  # latency rows concurrent load would poison) is running.  Matching the
+  # cmdline alone is not enough: the session driver's own process quotes
+  # "python bench.py" inside its prompt argument, which made a bare
+  # pgrep match FOREVER and silently starve the watcher of every probe
+  # (caught via the round-5 heartbeat log).  Require argv[0] to be a
+  # python interpreter so only real bench processes count.
+  local p a0
+  for p in $(pgrep -f "bench\.py|speed_runner\.py|hist_ablation\.py" 2>/dev/null); do
+    a0=$(tr '\0' '\n' < "/proc/$p/cmdline" 2>/dev/null | head -1)
+    case "$a0" in
+      *python*) return 0 ;;
+    esac
+  done
+  return 1
 }
 
 promote() {  # promote TMP DST PATTERN — move TMP over DST iff TMP has PATTERN
