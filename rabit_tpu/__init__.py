@@ -44,7 +44,7 @@ from rabit_tpu.api import (
     reset_collective_stats,
 )
 
-__version__ = "0.4.0"
+__version__ = "0.5.0"
 
 __all__ = [
     "MAX",

@@ -121,7 +121,11 @@ def test_fuzzed_kill_schedule(seed: int):
     cmd = [sys.executable, WORKER, "rabit_engine=mock", *args]
     cluster = LocalCluster(world, max_restarts=12, quiet=True)
     try:
-        rc = cluster.run(cmd, timeout=90.0)
+        # Same budget as the repo's own world-10 multi-kill scenario
+        # (test_reference_scale_10_workers_10k): the worst fuzzed shapes
+        # (world 10, 5 kills, oversubscribed single core) need headroom —
+        # a tight bound turns a passing schedule into a flaky seed.
+        rc = cluster.run(cmd, timeout=240.0)
     except Exception as e:  # noqa: BLE001 — re-raise with the repro recipe
         raise AssertionError(
             f"seed {seed}: world={world} args={args!r} failed: {e}"

@@ -31,3 +31,16 @@ def test_timed_context():
         pass
     assert s.ops["allgather"].calls == 1
     assert s.ops["allgather"].max_seconds >= 0
+
+
+def test_parse_stats_line():
+    from rabit_tpu.profile import parse_stats_line
+
+    line = ("[3] recover_stats version=2 summary_rounds=4 table_rounds=2 "
+            "serve_bytes=1048576 summary_depth=8 table_hops=14")
+    kv = parse_stats_line(line)
+    assert kv["version"] == "2"
+    assert int(kv["summary_depth"]) == 8
+    assert int(kv["table_hops"]) == 14
+    # values containing '=' split only on the first (key=value contract)
+    assert parse_stats_line("k=a=b x")["k"] == "a=b"

@@ -202,7 +202,8 @@ def test_recover_stats_lines():
     assert detected, f"no failure_detected line in {cluster.messages}"
     stats = [
         m for m in cluster.messages
-        if "recover_stats" in m and "version=0 " not in m
+        if "recover_stats " in m and "recover_stats_final" not in m
+        and "version=0 " not in m
     ]
     assert stats, f"no recovered-life recover_stats line in {cluster.messages}"
     from rabit_tpu.profile import parse_stats_line

@@ -28,7 +28,11 @@ python tools/speed_runner.py --json-out RESULTS/speed.jsonl
   python tools/consensus_bench.py --world 256 --iters 20
 } > RESULTS/consensus.jsonl
 python tools/recovery_bench.py 2 4 8 16 24 32 48 64 > RESULTS/recovery.jsonl
-python tools/recovery_bench.py --blob-mb 1 4 8 16 > RESULTS/recovery_blob.jsonl
+{
+  python tools/recovery_bench.py 4 --blob-mb 1 4 8 16
+  python tools/recovery_bench.py 2 8 16 --blob-mb 16
+  python tools/recovery_bench.py 4 --blob-mb 64
+} > RESULTS/recovery_blob.jsonl
 python tools/sklearn_baseline.py --json-out RESULTS/sklearn_baseline.json
 
 if [[ "${1:-}" == "--tpu" ]]; then

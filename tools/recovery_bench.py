@@ -83,7 +83,11 @@ def run_once(world: int, extra: list[str], timeout: float | None = None):
     # time at oversubscribed world sizes.
     events = None
     for m in cluster.messages:
-        if "recover_stats" not in m or "version=0 " in m:
+        # The shutdown-time recover_stats_final lines share the prefix but
+        # lack version=/serve_bytes; only the recovering rank's
+        # LoadCheckPoint line (version>0) holds the per-recovery counters.
+        if ("recover_stats " not in m or "recover_stats_final" in m
+                or "version=0 " in m):
             continue
         fields = parse_stats_line(m)
         events = {
