@@ -54,6 +54,14 @@ class GBDTConfig(NamedTuple):
     # fused and hook-based rounds alike; non-TPU backends (exact-f32
     # scatter) ignore it.
     mxu_i8: bool = False
+    # Final leaf pass of train_round_fused: True runs the fused Pallas
+    # route+margin kernel (ops/boost.py route_margin_level); False runs
+    # the routing-only kernel and leaves ``margin += leaf[node]`` to XLA
+    # (a 1M-row gather from a 2**depth-entry table).  Both are exact;
+    # this exists because the round-5 on-chip ablation measured the two
+    # within noise whole-round, so the choice is a measurable knob rather
+    # than a baked-in assumption (RESULTS/hist_ablation_i8.jsonl).
+    fused_final: bool = True
 
 
 class Forest(NamedTuple):
@@ -334,17 +342,24 @@ def train_round_fused(
         thrs.append(jnp.zeros(max_nodes, jnp.int32).at[: 2 ** d].set(thr))
     # Leaf (g, h) masses come straight off the final combined histogram
     # (split_child_masses) — already globally reduced, so no leaf collective
-    # and no histogram work in the last row pass, which routes rows to
-    # their leaves AND applies the margin update in one fused kernel
-    # (depth collectives per round, not depth+1; no host-level 1M-row
-    # gather from the leaf table).
+    # and no histogram work in the last row pass (depth collectives per
+    # round, not depth+1).  The last pass routes rows to their leaves and
+    # applies ``margin += leaf[node]`` either inside one fused kernel
+    # (cfg.fused_final) or as a routing kernel plus an XLA gather from the
+    # 2**depth-entry leaf table — the two measured within noise on-chip,
+    # so the choice is a config knob (RESULTS/final_pass.jsonl).
     leaf_gh = split_child_masses(hist, feat, thr)
     leaf = -cfg.learning_rate * leaf_gh[:, 0] / (leaf_gh[:, 1] + cfg.reg_lambda)
-    margin3, _ = boost.block_rows(state.margin, block)
-    margin3, _node3 = boost.route_margin_level(
-        xb3, node3, margin3, feat, thr, leaf, depth=cfg.depth,
-        interpret=interpret)
-    margin = boost.unblock_rows(margin3, n)
+    if cfg.fused_final:
+        margin3, _ = boost.block_rows(state.margin, block)
+        margin3, _node3 = boost.route_margin_level(
+            xb3, node3, margin3, feat, thr, leaf, depth=cfg.depth,
+            interpret=interpret)
+        margin = boost.unblock_rows(margin3, n)
+    else:
+        node3 = boost.route_level(xb3, node3, feat, thr, depth=cfg.depth,
+                                  interpret=interpret)
+        margin = state.margin + leaf[boost.unblock_rows(node3, n)]
     t = state.round
     forest = Forest(
         feature=lax.dynamic_update_index_in_dim(

@@ -86,6 +86,17 @@ def parse_stats_line(line: str) -> dict[str, str]:
     return dict(p.split("=", 1) for p in line.split() if "=" in p)
 
 
+def is_recovery_stats_line(line: str) -> bool:
+    """True for a recovered life's per-recovery ``recover_stats`` line from
+    LoadCheckPoint — the line whose counters the recovery bench and tests
+    consume.  Excludes the shutdown-time ``recover_stats_final`` lines
+    (shared prefix, no per-recovery fields) and first lives (version=0).
+    The companion predicate to :func:`parse_stats_line`, kept here for the
+    same reason: one point of truth for the line format."""
+    return ("recover_stats " in line and "recover_stats_final" not in line
+            and "version=0 " not in line)
+
+
 @contextlib.contextmanager
 def xla_trace(logdir: str):
     """Capture an XLA device trace for TensorBoard/xprof — the TPU-native

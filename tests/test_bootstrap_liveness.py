@@ -54,7 +54,11 @@ def _checkin_then_vanish(tracker) -> None:
     lst.bind(("127.0.0.1", 0))
     lst.listen(4)
     port = lst.getsockname()[1]
-    tr = socket.create_connection((tracker.host, tracker.port), timeout=30)
+    # Generous timeout: the wave assignment arrives only after BOTH real
+    # workers check in, and their process startup can take tens of seconds
+    # when the suite runs under heavy parallel load.  The timeout exists
+    # only to bound a genuine hang, not to race worker startup.
+    tr = socket.create_connection((tracker.host, tracker.port), timeout=120)
     tr.sendall(
         protocol.put_u32(protocol.MAGIC_HELLO)
         + protocol.put_u32(protocol.CMD_START)

@@ -157,14 +157,18 @@ def test_sharded_training_matches_single(use_fp):
     np.testing.assert_allclose(np.asarray(sstate.margin), ref_margin, rtol=1e-4)
 
 
-def test_train_round_fused_matches_reference():
+@pytest.mark.parametrize("fused_final", [True, False])
+def test_train_round_fused_matches_reference(fused_final):
     """The fused Pallas round (ops.boost, run via the Pallas interpreter on
-    CPU) must grow the exact same trees as the hook-based train_round."""
+    CPU) must grow the exact same trees as the hook-based train_round —
+    with either final leaf pass (fused route+margin kernel, or routing
+    kernel + XLA leaf gather)."""
     from rabit_tpu.ops import boost
 
     rng = np.random.RandomState(3)
     n, f = 600, 5
-    cfg = gbdt.GBDTConfig(n_features=f, n_trees=3, depth=3, n_bins=16)
+    cfg = gbdt.GBDTConfig(n_features=f, n_trees=3, depth=3, n_bins=16,
+                          fused_final=fused_final)
     xb = jnp.asarray(rng.randint(0, cfg.n_bins, size=(n, f)), jnp.int32)
     y = jnp.asarray(rng.randint(0, 2, size=n), jnp.float32)
     xb3, _ = boost.block_rows(xb, 256)

@@ -200,13 +200,10 @@ def test_recover_stats_lines():
         4, ["niter=3", "mock=1,1,1,0", "rabit_recover_stats=1"])
     detected = [m for m in cluster.messages if "failure_detected at=" in m]
     assert detected, f"no failure_detected line in {cluster.messages}"
-    stats = [
-        m for m in cluster.messages
-        if "recover_stats " in m and "recover_stats_final" not in m
-        and "version=0 " not in m
-    ]
+    from rabit_tpu.profile import is_recovery_stats_line, parse_stats_line
+
+    stats = [m for m in cluster.messages if is_recovery_stats_line(m)]
     assert stats, f"no recovered-life recover_stats line in {cluster.messages}"
-    from rabit_tpu.profile import parse_stats_line
 
     fields = parse_stats_line(stats[0])
     assert int(fields["summary_rounds"]) >= 1

@@ -50,6 +50,10 @@ def main() -> int:
     ap.add_argument("--quick", action="store_true",
                     help="only the pallas bf16-vs-i8 hist kernels at the "
                          "deepest level — fits a short TPU-tunnel window")
+    ap.add_argument("--whole-round-only", action="store_true",
+                    help="only the train_round_fused {bf16,i8} x "
+                         "{fused,xla}-final whole-round rows — the "
+                         "GBDTConfig.fused_final decision experiment")
     ap.add_argument("--json-out", default="")
     args = ap.parse_args()
 
@@ -75,8 +79,20 @@ def main() -> int:
     h = jnp.asarray(rng.rand(args.rows), jnp.float32)
 
     records = []
+    # No kernel here can legitimately beat 1 ms per 1M rows on one chip
+    # (measured floors: 21 ms hist, ~47 ms route at 1M); anything under
+    # this is the degraded-tunnel failure mode where dispatches return
+    # unexecuted (0.1 ms "rounds", seen live in round 5).  Guard EVERY
+    # emitted row: the watcher promotes on row presence, so a written
+    # file must be trustworthy end to end.
+    floor_ms = 1.0 * args.rows / 1e6 if plat == "tpu" else 0.0
 
     def emit(rec):
+        if "ms" in rec and rec["ms"] < floor_ms:
+            print(f"BOGUS timing {rec['ms']} ms (< {floor_ms:.3f} ms "
+                  "floor) — degraded tunnel, aborting without writing",
+                  file=sys.stderr)
+            sys.exit(3)  # before any json-out write: no partial artifact
         rec.update(platform=plat, rows=args.rows, feats=args.feats,
                    bins=args.bins)
         records.append(rec)
@@ -86,13 +102,13 @@ def main() -> int:
         "scatter": hist.node_histograms_scatter,
         "onehot": hist.node_histograms_onehot,
     }
-    if args.quick:
+    if args.quick or args.whole_round_only:
         if plat != "tpu":
-            print("--quick benchmarks only the Pallas TPU kernels; no TPU "
-                  "backend is active", file=sys.stderr)
+            print("--quick/--whole-round-only benchmark only the Pallas "
+                  "TPU kernels; no TPU backend is active", file=sys.stderr)
             return 2
         impls = {}
-    if plat == "tpu":
+    if plat == "tpu" and not args.whole_round_only:
         impls["pallas"] = hist.node_histograms_pallas
         impls["pallas_i8"] = functools.partial(
             hist.node_histograms_pallas, mxu_i8=True)
@@ -109,7 +125,8 @@ def main() -> int:
 
     # Fused route+hist level step vs the hist alone: the difference is the
     # routing cost the fused kernel folds into the same HBM pass.
-    if plat == "tpu" and not args.quick:
+    xb3 = None
+    if plat == "tpu" and not args.quick and not args.whole_round_only:
         xb3, _ = boost.block_rows(xb)
         g3, _ = boost.block_rows(g)
         h3, _ = boost.block_rows(h)
@@ -160,22 +177,28 @@ def main() -> int:
         emit({"kernel": "route_margin_level", "depth": args.depth,
               "ms": round(dt * 1e3, 3)})
 
-        # Whole fused round, both MXU modes — ties the per-kernel numbers
-        # to the headline rounds/s metric in one provenance-consistent run
-        # (same plat gate as above: reuses xb3).
+    # Whole fused round, {bf16, i8} x {fused, xla}-final — ties the
+    # per-kernel numbers to the headline rounds/s metric in one
+    # provenance-consistent run, and decides GBDTConfig.fused_final.
+    if plat == "tpu" and not args.quick:
         from rabit_tpu.models import gbdt
 
+        if xb3 is None:
+            xb3, _ = boost.block_rows(xb)
         y = jnp.asarray(rng.randint(0, 2, size=args.rows), jnp.float32)
         for i8 in (False, True):
-            cfg = gbdt.GBDTConfig(n_features=args.feats, n_trees=8,
-                                  depth=args.depth, n_bins=args.bins,
-                                  mxu_i8=i8)
-            step = jax.jit(functools.partial(gbdt.train_round_fused, cfg=cfg))
-            state = gbdt.init_state(cfg, args.rows)
-            dt = timed(step, state, xb3, y, n=4)
-            emit({"kernel": "train_round_fused" + ("_i8" if i8 else ""),
-                  "depth": args.depth, "ms": round(dt * 1e3, 3),
-                  "rounds_per_sec": round(1.0 / dt, 2)})
+            for ff in (True, False):
+                cfg = gbdt.GBDTConfig(n_features=args.feats, n_trees=8,
+                                      depth=args.depth, n_bins=args.bins,
+                                      mxu_i8=i8, fused_final=ff)
+                step = jax.jit(
+                    functools.partial(gbdt.train_round_fused, cfg=cfg))
+                state = gbdt.init_state(cfg, args.rows)
+                dt = timed(step, state, xb3, y, n=4)
+                emit({"kernel": "train_round_fused" + ("_i8" if i8 else "")
+                      + ("" if ff else "_xlafinal"),
+                      "depth": args.depth, "ms": round(dt * 1e3, 3),
+                      "rounds_per_sec": round(1.0 / dt, 2)})
 
     if args.json_out:
         out = Path(args.json_out)

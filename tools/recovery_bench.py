@@ -33,7 +33,7 @@ from pathlib import Path
 REPO = Path(__file__).resolve().parents[1]
 sys.path.insert(0, str(REPO))
 
-from rabit_tpu.profile import parse_stats_line  # noqa: E402
+from rabit_tpu.profile import is_recovery_stats_line, parse_stats_line  # noqa: E402
 from rabit_tpu.tracker.launcher import LocalCluster, cpu_worker_env  # noqa: E402
 
 WORKER = str(REPO / "tests" / "workers" / "recover_worker.py")
@@ -83,11 +83,7 @@ def run_once(world: int, extra: list[str], timeout: float | None = None):
     # time at oversubscribed world sizes.
     events = None
     for m in cluster.messages:
-        # The shutdown-time recover_stats_final lines share the prefix but
-        # lack version=/serve_bytes; only the recovering rank's
-        # LoadCheckPoint line (version>0) holds the per-recovery counters.
-        if ("recover_stats " not in m or "recover_stats_final" in m
-                or "version=0 " in m):
+        if not is_recovery_stats_line(m):
             continue
         fields = parse_stats_line(m)
         events = {
