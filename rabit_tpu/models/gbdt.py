@@ -62,6 +62,14 @@ class GBDTConfig(NamedTuple):
     # within noise whole-round, so the choice is a measurable knob rather
     # than a baked-in assumption (RESULTS/hist_ablation_i8.jsonl).
     fused_final: bool = True
+    # Split each row block into this many independent sub-contractions in
+    # the level kernels' histogram accumulation (ops/boost.py _accum):
+    # sub-block i's MXU matmul has no dependency on sub-block i+1's VPU
+    # indicator build, giving Mosaic explicit overlap room (the measured
+    # VPU/MXU co-dominance headroom, RESULTS.md §1).  Must divide the row
+    # block (1024); results are added in f32.  Default 1 = current
+    # single-contraction form; >1 is the on-chip ablation's experiment.
+    r_split: int = 1
 
 
 class Forest(NamedTuple):
@@ -326,7 +334,8 @@ def train_round_fused(
         )
 
     hist = combine(boost.hist_level0(xb3, g3, h3, n_bins=cfg.n_bins,
-                                     interpret=interpret, mxu_i8=cfg.mxu_i8))
+                                     interpret=interpret, mxu_i8=cfg.mxu_i8,
+                                     r_split=cfg.r_split))
     feat, thr, _ = best_splits(hist, cfg)
     feats = [jnp.zeros(max_nodes, jnp.int32).at[:1].set(feat)]
     thrs = [jnp.zeros(max_nodes, jnp.int32).at[:1].set(thr)]
@@ -335,7 +344,8 @@ def train_round_fused(
         hist, node3 = boost.hist_level(xb3, node3, g3, h3, feat, thr,
                                        depth=d, n_bins=cfg.n_bins,
                                        interpret=interpret,
-                                       mxu_i8=cfg.mxu_i8)
+                                       mxu_i8=cfg.mxu_i8,
+                                       r_split=cfg.r_split)
         hist = combine(hist)
         feat, thr, _ = best_splits(hist, cfg)
         feats.append(jnp.zeros(max_nodes, jnp.int32).at[: 2 ** d].set(feat))

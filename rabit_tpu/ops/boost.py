@@ -40,6 +40,12 @@ def _round_up(x: int, m: int) -> int:
     return -(-x // m) * m
 
 
+def _check_r_split(R: int, r_split: int) -> None:
+    if r_split < 1 or R % r_split:
+        raise ValueError(
+            f"r_split={r_split} must be >= 1 and divide the row block {R}")
+
+
 def _bins_eff(n_bins: int) -> int:
     """Mask width per feature: bins padded to full 128-lane registers (the
     pad columns never match a bin id, so they stay zero)."""
@@ -100,21 +106,44 @@ def _encode_i8(L):
     return l2, jnp.int8, jnp.int32, decode
 
 
-def _accum(xb_blk, L, out_ref, *, n_bins: int, n_feat: int, fc: int, i8: bool):
+def _accum(xb_blk, L, out_ref, *, n_bins: int, n_feat: int, fc: int, i8: bool,
+           r_split: int = 1):
     """out_ref[m, f*Beff+b] += sum_r L[r, m] * [xb_blk[r, f] == b], via the
     MXU: the encoded gradient planes are contracted against per-feature-
-    group bin-indicator matrices built in VMEM."""
+    group bin-indicator matrices built in VMEM.
+
+    ``r_split > 1`` splits the row block into that many independent
+    sub-contractions per feature group (raw accumulators summed, one
+    decode; bitwise identical to the unsplit path for i8, f32-sum
+    reassociation only for bf16) — an overlap experiment: sub-block i's
+    matmul (MXU) has no data dependency on sub-block i+1's indicator
+    build (VPU), giving Mosaic's scheduler explicit room to run them
+    concurrently.  Round-5 on-chip roofline: the ~3.7 ms/level indicator
+    rebuild is co-dominant with the int8-rate matmul, so full overlap is
+    worth up to ~25% of the round (RESULTS.md §1); measured by the
+    ablation's rsplit rows."""
     be = _bins_eff(n_bins)
     l2, onehot_dtype, acc_dtype, decode = (_encode_i8 if i8 else _encode_bf16)(L)
     r = xb_blk.shape[0]
-    b_iota = lax.broadcasted_iota(jnp.int32, (r, be), 1)
+    rs = r // r_split
+    b_iota = lax.broadcasted_iota(jnp.int32, (rs, be), 1)
     for gi in range(0, n_feat, fc):
         k = min(fc, n_feat - gi)
-        onehot = jnp.concatenate(
-            [(xb_blk[:, f : f + 1] == b_iota) for f in range(gi, gi + k)],
-            axis=1,
-        ).astype(onehot_dtype)
-        acc2 = lax.dot_general(l2, onehot, _DN, preferred_element_type=acc_dtype)
+        # Sum the RAW accumulators across sub-blocks and decode once:
+        # decode is linear, so this is bitwise identical to the unsplit
+        # path for i8 (int32 adds commute exactly) and costs one decode
+        # per group instead of r_split.
+        acc2 = None
+        for s in range(r_split):
+            lo = s * rs
+            onehot = jnp.concatenate(
+                [(xb_blk[lo : lo + rs, f : f + 1] == b_iota)
+                 for f in range(gi, gi + k)],
+                axis=1,
+            ).astype(onehot_dtype)
+            part = lax.dot_general(l2[lo : lo + rs], onehot, _DN,
+                                   preferred_element_type=acc_dtype)
+            acc2 = part if acc2 is None else acc2 + part
         out_ref[:, gi * be : (gi + k) * be] += decode(acc2)
 
 
@@ -145,7 +174,8 @@ def _route(xb_blk, node, feat_row, thr_row, *, p_pad: int, n_feat: int):
 # -- level 0: histogram at the root ----------------------------------------
 
 
-def _level0_kernel(xb_ref, g_ref, h_ref, out_ref, *, n_bins, n_feat, fc, i8):
+def _level0_kernel(xb_ref, g_ref, h_ref, out_ref, *, n_bins, n_feat, fc, i8,
+                   r_split=1):
     @pl.when(pl.program_id(0) == 0)
     def _init():
         out_ref[:] = jnp.zeros_like(out_ref)
@@ -153,7 +183,8 @@ def _level0_kernel(xb_ref, g_ref, h_ref, out_ref, *, n_bins, n_feat, fc, i8):
     r = g_ref.shape[1]
     node = jnp.zeros((r, 1), jnp.int32)
     L = _gradient_matrix(node, g_ref[0], h_ref[0], n_nodes=1, m_pad=8)
-    _accum(xb_ref[0], L, out_ref, n_bins=n_bins, n_feat=n_feat, fc=fc, i8=i8)
+    _accum(xb_ref[0], L, out_ref, n_bins=n_bins, n_feat=n_feat, fc=fc, i8=i8,
+           r_split=r_split)
 
 
 # -- level d >= 1: route + histogram ---------------------------------------
@@ -161,7 +192,7 @@ def _level0_kernel(xb_ref, g_ref, h_ref, out_ref, *, n_bins, n_feat, fc, i8):
 
 def _level_kernel(xb_ref, node_ref, g_ref, h_ref, feat_ref, thr_ref,
                   out_ref, node_out_ref, *,
-                  n_nodes, n_bins, n_feat, m_pad, p_pad, fc, i8):
+                  n_nodes, n_bins, n_feat, m_pad, p_pad, fc, i8, r_split=1):
     @pl.when(pl.program_id(0) == 0)
     def _init():
         out_ref[:] = jnp.zeros_like(out_ref)
@@ -170,7 +201,8 @@ def _level_kernel(xb_ref, node_ref, g_ref, h_ref, feat_ref, thr_ref,
                   p_pad=p_pad, n_feat=n_feat)
     node_out_ref[0] = node
     L = _gradient_matrix(node, g_ref[0], h_ref[0], n_nodes=n_nodes, m_pad=m_pad)
-    _accum(xb_ref[0], L, out_ref, n_bins=n_bins, n_feat=n_feat, fc=fc, i8=i8)
+    _accum(xb_ref[0], L, out_ref, n_bins=n_bins, n_feat=n_feat, fc=fc, i8=i8,
+           r_split=r_split)
 
 
 # -- routing-only pass (leaf assignment without histogramming) -------------
@@ -289,16 +321,19 @@ def _leaf_kernel(xb_ref, node_ref, g_ref, h_ref, feat_ref, thr_ref,
 _blk = lambda R, k: pl.BlockSpec((1, R, k), lambda i: (i, 0, 0))
 
 
-@functools.partial(jax.jit, static_argnames=("n_bins", "interpret", "mxu_i8"))
+@functools.partial(
+    jax.jit, static_argnames=("n_bins", "interpret", "mxu_i8", "r_split")
+)
 def hist_level0(xb3, g3, h3, *, n_bins: int, interpret: bool = False,
-                mxu_i8: bool = False):
-    """Root histogram; [1, F, B, 2]."""
+                mxu_i8: bool = False, r_split: int = 1):
+    """Root histogram; [1, F, B, 2].  ``r_split``: see _accum."""
     nb, R, F = xb3.shape
+    _check_r_split(R, r_split)
     be = _bins_eff(n_bins)
     fc = _pick_fc(F, n_bins)
     out = pl.pallas_call(
         functools.partial(_level0_kernel, n_bins=n_bins, n_feat=F, fc=fc,
-                          i8=mxu_i8),
+                          i8=mxu_i8, r_split=r_split),
         grid=(nb,),
         in_specs=[_blk(R, F), _blk(R, 1), _blk(R, 1)],
         out_specs=pl.BlockSpec((8, F * be), lambda i: (0, 0)),
@@ -310,14 +345,17 @@ def hist_level0(xb3, g3, h3, *, n_bins: int, interpret: bool = False,
 
 
 @functools.partial(
-    jax.jit, static_argnames=("depth", "n_bins", "interpret", "mxu_i8")
+    jax.jit,
+    static_argnames=("depth", "n_bins", "interpret", "mxu_i8", "r_split"),
 )
 def hist_level(xb3, node3, g3, h3, feat, thr, *, depth: int, n_bins: int,
-               interpret: bool = False, mxu_i8: bool = False):
+               interpret: bool = False, mxu_i8: bool = False,
+               r_split: int = 1):
     """Route one level down and histogram; returns
     ([2**depth, F, B, 2], node3').  ``feat``/``thr`` are the level-(depth-1)
-    split tables, shape [2**(depth-1)]."""
+    split tables, shape [2**(depth-1)].  ``r_split``: see _accum."""
     nb, R, F = xb3.shape
+    _check_r_split(R, r_split)
     be = _bins_eff(n_bins)
     n_nodes = 2 ** depth
     n_prev = 2 ** (depth - 1)
@@ -329,7 +367,7 @@ def hist_level(xb3, node3, g3, h3, feat, thr, *, depth: int, n_bins: int,
     out, node_out = pl.pallas_call(
         functools.partial(
             _level_kernel, n_nodes=n_nodes, n_bins=n_bins, n_feat=F,
-            m_pad=m_pad, p_pad=p_pad, fc=fc, i8=mxu_i8,
+            m_pad=m_pad, p_pad=p_pad, fc=fc, i8=mxu_i8, r_split=r_split,
         ),
         grid=(nb,),
         in_specs=[

@@ -258,6 +258,39 @@ def test_hist_impls_agree():
     )
 
 
+@pytest.mark.parametrize("mxu_i8", [False, True])
+def test_hist_level_rsplit_matches(mxu_i8):
+    """The r_split sub-contraction form of the level kernel (the VPU/MXU
+    overlap experiment, ops/boost.py _accum) must produce the same
+    histograms and routing as the single-contraction default — the split
+    only reassociates the f32 row sum."""
+    from rabit_tpu.ops import boost
+
+    rng = np.random.RandomState(11)
+    n, F, B, d = 512, 5, 16, 2
+    n_prev = 1 << (d - 1)
+    xb3, _ = boost.block_rows(
+        jnp.asarray(rng.randint(0, B, size=(n, F)), jnp.int32), 256)
+    g3, _ = boost.block_rows(jnp.asarray(rng.randn(n), jnp.float32), 256)
+    h3, _ = boost.block_rows(jnp.asarray(rng.rand(n), jnp.float32), 256)
+    node3 = jnp.asarray(rng.randint(0, n_prev, size=g3.shape), jnp.int32)
+    feat = jnp.asarray(rng.randint(0, F, size=n_prev), jnp.int32)
+    thr = jnp.asarray(rng.randint(0, B, size=n_prev), jnp.int32)
+    ref_h, ref_n = boost.hist_level(xb3, node3, g3, h3, feat, thr, depth=d,
+                                    n_bins=B, interpret=True, mxu_i8=mxu_i8)
+    got_h, got_n = boost.hist_level(xb3, node3, g3, h3, feat, thr, depth=d,
+                                    n_bins=B, interpret=True, mxu_i8=mxu_i8,
+                                    r_split=2)
+    np.testing.assert_array_equal(np.asarray(got_n), np.asarray(ref_n))
+    np.testing.assert_allclose(np.asarray(got_h), np.asarray(ref_h),
+                               rtol=1e-5, atol=1e-5)
+    with pytest.raises(ValueError, match="divide the row block"):
+        boost.hist_level(xb3, node3, g3, h3, feat, thr, depth=d, n_bins=B,
+                         interpret=True, r_split=3)
+    with pytest.raises(ValueError, match="divide the row block"):
+        boost.hist_level0(xb3, g3, h3, n_bins=B, interpret=True, r_split=0)
+
+
 def test_train_round_dp_fused_matches_dp():
     """The fused dp round (Pallas interpreter under shard_map on the CPU
     mesh) must grow the same trees as the hook-based train_round_dp."""

@@ -55,6 +55,14 @@ def test_fused_level_kernels_lower(i8):
             functools.partial(boost.hist_level, depth=d, n_bins=B, mxu_i8=i8),
             xb3, node3, g3, h3, tab, tab,
         )
+    # The r_split overlap experiment must lower before the watcher spends
+    # chip time measuring it (the exact failure mode this file exists for).
+    tab = jnp.zeros(1 << 4, jnp.int32)
+    export_tpu(
+        functools.partial(boost.hist_level, depth=5, n_bins=B, mxu_i8=i8,
+                          r_split=2),
+        xb3, node3, g3, h3, tab, tab,
+    )
 
 
 def test_route_and_leaf_kernels_lower():

@@ -186,19 +186,28 @@ def main() -> int:
         if xb3 is None:
             xb3, _ = boost.block_rows(xb)
         y = jnp.asarray(rng.randint(0, 2, size=args.rows), jnp.float32)
+        def whole_round(tag, **kw):
+            cfg = gbdt.GBDTConfig(n_features=args.feats, n_trees=8,
+                                  depth=args.depth, n_bins=args.bins, **kw)
+            step = jax.jit(functools.partial(gbdt.train_round_fused, cfg=cfg))
+            state = gbdt.init_state(cfg, args.rows)
+            dt = timed(step, state, xb3, y, n=4)
+            emit({"kernel": tag, "depth": args.depth,
+                  "ms": round(dt * 1e3, 3),
+                  "rounds_per_sec": round(1.0 / dt, 2)})
+
         for i8 in (False, True):
             for ff in (True, False):
-                cfg = gbdt.GBDTConfig(n_features=args.feats, n_trees=8,
-                                      depth=args.depth, n_bins=args.bins,
-                                      mxu_i8=i8, fused_final=ff)
-                step = jax.jit(
-                    functools.partial(gbdt.train_round_fused, cfg=cfg))
-                state = gbdt.init_state(cfg, args.rows)
-                dt = timed(step, state, xb3, y, n=4)
-                emit({"kernel": "train_round_fused" + ("_i8" if i8 else "")
-                      + ("" if ff else "_xlafinal"),
-                      "depth": args.depth, "ms": round(dt * 1e3, 3),
-                      "rounds_per_sec": round(1.0 / dt, 2)})
+                whole_round("train_round_fused" + ("_i8" if i8 else "")
+                            + ("" if ff else "_xlafinal"),
+                            mxu_i8=i8, fused_final=ff)
+        if args.whole_round_only:
+            # The VPU/MXU overlap experiment (GBDTConfig.r_split, see
+            # ops/boost.py _accum) — only in the focused mode so the full
+            # ablation's runtime stays inside the watcher's stage cap.
+            for i8 in (False, True):
+                whole_round("train_round_fused" + ("_i8" if i8 else "")
+                            + "_rsplit2", mxu_i8=i8, r_split=2)
 
     if args.json_out:
         out = Path(args.json_out)
