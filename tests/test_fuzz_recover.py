@@ -24,6 +24,7 @@ schedule.
 
 from __future__ import annotations
 
+import os
 import random
 import sys
 from pathlib import Path
@@ -34,7 +35,11 @@ from rabit_tpu.tracker.launcher import LocalCluster
 
 WORKER = str(Path(__file__).parent / "workers" / "recover_worker.py")
 
-N_SEEDS = 60
+# CI default 60 seeds; both knobs exist so longer campaigns can run FRESH
+# schedules (e.g. RABIT_FUZZ_SEED_BASE=60 RABIT_FUZZ_SEEDS=120 explores
+# seeds 60..179) without re-treading the committed range.
+N_SEEDS = int(os.environ.get("RABIT_FUZZ_SEEDS", "60"))
+SEED_BASE = int(os.environ.get("RABIT_FUZZ_SEED_BASE", "0"))
 OPS_PER_ITER = 5      # recover_worker seq layout: 0..4
 SPECIAL_SEQNOS = (-1, -3)   # checkpoint entry, commit window
 
@@ -115,7 +120,8 @@ def draw_schedule(seed: int) -> tuple[int, list[str]]:
     return world, args
 
 
-@pytest.mark.parametrize("seed", range(N_SEEDS), ids=lambda s: f"seed{s}")
+@pytest.mark.parametrize("seed", range(SEED_BASE, SEED_BASE + N_SEEDS),
+                         ids=lambda s: f"seed{s}")
 def test_fuzzed_kill_schedule(seed: int):
     world, args = draw_schedule(seed)
     cmd = [sys.executable, WORKER, "rabit_engine=mock", *args]
