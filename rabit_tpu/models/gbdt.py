@@ -57,11 +57,14 @@ class GBDTConfig(NamedTuple):
     # Final leaf pass of train_round_fused: True runs the fused Pallas
     # route+margin kernel (ops/boost.py route_margin_level); False runs
     # the routing-only kernel and leaves ``margin += leaf[node]`` to XLA
-    # (a 1M-row gather from a 2**depth-entry table).  Both are exact;
-    # this exists because the round-5 on-chip ablation measured the two
-    # within noise whole-round, so the choice is a measurable knob rather
-    # than a baked-in assumption (RESULTS/hist_ablation_i8.jsonl).
-    fused_final: bool = True
+    # (a 1M-row gather from a 2**depth-entry table).  Both are exact.
+    # The round-5 whole-round on-chip measurements decided the default:
+    # XLA-final won in BOTH MXU modes and in three independent runs
+    # (73.8 vs 78.1 ms bf16, 77.3 vs 78.7 ms i8 — RESULTS/final_pass.jsonl;
+    # 70.0/70.1 vs 74.0/72.8 ms in the driver-bench races), so False is
+    # the measured default and the fused kernel stays as the challenger
+    # bench.py re-races each capture.
+    fused_final: bool = False
     # Split each row block into this many independent sub-contractions in
     # the level kernels' histogram accumulation (ops/boost.py _accum):
     # sub-block i's MXU matmul has no dependency on sub-block i+1's VPU
@@ -356,8 +359,9 @@ def train_round_fused(
     # round, not depth+1).  The last pass routes rows to their leaves and
     # applies ``margin += leaf[node]`` either inside one fused kernel
     # (cfg.fused_final) or as a routing kernel plus an XLA gather from the
-    # 2**depth-entry leaf table — the two measured within noise on-chip,
-    # so the choice is a config knob (RESULTS/final_pass.jsonl).
+    # 2**depth-entry leaf table — the gather form measured faster
+    # whole-round in both MXU modes and is the default; see the
+    # GBDTConfig.fused_final docstring (RESULTS/final_pass.jsonl).
     leaf_gh = split_child_masses(hist, feat, thr)
     leaf = -cfg.learning_rate * leaf_gh[:, 0] / (leaf_gh[:, 1] + cfg.reg_lambda)
     if cfg.fused_final:

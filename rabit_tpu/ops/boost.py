@@ -126,6 +126,13 @@ def _accum(xb_blk, L, out_ref, *, n_bins: int, n_feat: int, fc: int, i8: bool,
     l2, onehot_dtype, acc_dtype, decode = (_encode_i8 if i8 else _encode_bf16)(L)
     r = xb_blk.shape[0]
     rs = r // r_split
+    # The indicator compare runs at i32 lane width BY TARGET CONSTRAINT,
+    # not choice: narrow codes (int8 4/lane, bf16 2/lane) would cut the
+    # co-dominant ~3.7 ms/level VPU rebuild 2-4x, but the chip's Mosaic
+    # rejects sub-32-bit vector compares — "Target does not support this
+    # comparison" on vector<...xi8> cmpi AND vector<...xbf16> cmpf
+    # (RESULTS/narrow_compare_rejection.txt; the local jax.export gate
+    # accepts both, so only on-chip compiles catch this).
     b_iota = lax.broadcasted_iota(jnp.int32, (rs, be), 1)
     for gi in range(0, n_feat, fc):
         k = min(fc, n_feat - gi)

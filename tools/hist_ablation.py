@@ -102,7 +102,8 @@ def main() -> int:
         "scatter": hist.node_histograms_scatter,
         "onehot": hist.node_histograms_onehot,
     }
-    if args.quick or args.whole_round_only:
+    focused = args.quick or args.whole_round_only
+    if focused:
         if plat != "tpu":
             print("--quick/--whole-round-only benchmark only the Pallas "
                   "TPU kernels; no TPU backend is active", file=sys.stderr)
@@ -126,7 +127,7 @@ def main() -> int:
     # Fused route+hist level step vs the hist alone: the difference is the
     # routing cost the fused kernel folds into the same HBM pass.
     xb3 = None
-    if plat == "tpu" and not args.quick and not args.whole_round_only:
+    if plat == "tpu" and not focused:
         xb3, _ = boost.block_rows(xb)
         g3, _ = boost.block_rows(g)
         h3, _ = boost.block_rows(h)
