@@ -19,7 +19,9 @@ that's a different test (``test_recover.py`` covers budget behavior).
 
 On failure pytest's parametrize id names the seed; reproduce with
 ``pytest tests/test_fuzz_recover.py -k 'seed17' -x`` and the printed
-schedule.
+schedule — carrying over the campaign's RABIT_FUZZ_WORLD_MAX (the
+failure message records it): the seed->schedule expansion depends on
+it, so the default re-draws a DIFFERENT schedule for the same seed.
 """
 
 from __future__ import annotations
@@ -37,9 +39,13 @@ WORKER = str(Path(__file__).parent / "workers" / "recover_worker.py")
 
 # CI default 60 seeds; both knobs exist so longer campaigns can run FRESH
 # schedules (e.g. RABIT_FUZZ_SEED_BASE=60 RABIT_FUZZ_SEEDS=120 explores
-# seeds 60..179) without re-treading the committed range.
+# seeds 60..179) without re-treading the committed range.  WORLD_MAX
+# widens the drawn world range past the CI default of 10 (campaigns at
+# 16 stress deeper trees/longer rings; CI stays at 10 for wall-clock —
+# a world-W run forks W processes per life on this single-core box).
 N_SEEDS = int(os.environ.get("RABIT_FUZZ_SEEDS", "60"))
 SEED_BASE = int(os.environ.get("RABIT_FUZZ_SEED_BASE", "0"))
+WORLD_MAX = int(os.environ.get("RABIT_FUZZ_WORLD_MAX", "10"))
 OPS_PER_ITER = 5      # recover_worker seq layout: 0..4
 SPECIAL_SEQNOS = (-1, -3)   # checkpoint entry, commit window
 
@@ -47,7 +53,7 @@ SPECIAL_SEQNOS = (-1, -3)   # checkpoint entry, commit window
 def draw_schedule(seed: int) -> tuple[int, list[str]]:
     """Deterministically expand ``seed`` into (world, worker_args)."""
     rng = random.Random(seed)
-    world = rng.randint(3, 10)
+    world = rng.randint(3, WORLD_MAX)
     niter = rng.choice([3, 4])
     use_local = rng.random() < 0.30
     use_lazy = (not use_local) and rng.random() < 0.25
@@ -127,16 +133,23 @@ def test_fuzzed_kill_schedule(seed: int):
     cmd = [sys.executable, WORKER, "rabit_engine=mock", *args]
     cluster = LocalCluster(world, max_restarts=12, quiet=True)
     try:
-        # Same budget as the repo's own world-10 multi-kill scenario
-        # (test_reference_scale_10_workers_10k): the worst fuzzed shapes
-        # (world 10, 5 kills, oversubscribed single core) need headroom —
-        # a tight bound turns a passing schedule into a flaky seed.
-        rc = cluster.run(cmd, timeout=240.0)
+        # Base budget: the repo's own world-10 multi-kill scenario
+        # (test_reference_scale_10_workers_10k) sized for the worst
+        # default-range shape (world 10, 5 kills, oversubscribed single
+        # core) — a tight bound turns a passing schedule into a flaky
+        # seed.  Wall time grows ~linearly in world (W forked processes
+        # per life on one core), so stress campaigns past the default
+        # range scale the budget proportionally.
+        rc = cluster.run(cmd, timeout=240.0 * max(1.0, WORLD_MAX / 10.0))
     except Exception as e:  # noqa: BLE001 — re-raise with the repro recipe
         raise AssertionError(
-            f"seed {seed}: world={world} args={args!r} failed: {e}"
+            f"seed {seed} (RABIT_FUZZ_WORLD_MAX={WORLD_MAX}): "
+            f"world={world} args={args!r} failed: {e}"
         ) from e
-    assert rc == 0, f"seed {seed}: world={world} args={args!r} rc={rc}"
+    assert rc == 0, (
+        f"seed {seed} (RABIT_FUZZ_WORLD_MAX={WORLD_MAX}): "
+        f"world={world} args={args!r} rc={rc}")
     assert all(r == 0 for r in cluster.returncodes), (
-        f"seed {seed}: world={world} args={args!r} "
+        f"seed {seed} (RABIT_FUZZ_WORLD_MAX={WORLD_MAX}): "
+        f"world={world} args={args!r} "
         f"returncodes={cluster.returncodes}")
