@@ -135,6 +135,12 @@ def device_worker(n_rows, n_rounds, force_cpu):
 
         force_cpu_platform(1)
 
+    from rabit_tpu._platform import enable_persistent_cache
+
+    # Warm-cache bench wall is ~25s vs 220-488s cold (the three raced
+    # configs each cost ~70-100s of Mosaic compile) — see the helper.
+    enable_persistent_cache()
+
     import jax
     import jax.numpy as jnp
 

@@ -46,6 +46,9 @@ WORKER = str(Path(__file__).parent / "workers" / "recover_worker.py")
 N_SEEDS = int(os.environ.get("RABIT_FUZZ_SEEDS", "60"))
 SEED_BASE = int(os.environ.get("RABIT_FUZZ_SEED_BASE", "0"))
 WORLD_MAX = int(os.environ.get("RABIT_FUZZ_WORLD_MAX", "10"))
+assert WORLD_MAX >= 3, (
+    f"RABIT_FUZZ_WORLD_MAX={WORLD_MAX}: the schedule draw needs world >= 3 "
+    "(rng.randint(3, WORLD_MAX)); the knob only widens the range upward")
 OPS_PER_ITER = 5      # recover_worker seq layout: 0..4
 SPECIAL_SEQNOS = (-1, -3)   # checkpoint entry, commit window
 

@@ -63,6 +63,12 @@ def main() -> int:
         force_cpu_platform(1)
         args.rows = min(args.rows, 20_000)
 
+    from rabit_tpu._platform import enable_persistent_cache
+
+    # Repeat captures (watcher retries, knob sweeps) skip the ~70-100s
+    # Mosaic compile per config; timing loops only ever measure runs.
+    enable_persistent_cache()
+
     import jax
     import jax.numpy as jnp
     import numpy as np
