@@ -47,6 +47,12 @@ echo "[super $(date +%T)] supervisor start (pid $$)" >> "$LOG"
 watcher_alive() {
   # The watcher holds an exclusive flock on RESULTS/.watcher.lock for its
   # whole life; if we can grab it, no watcher (ours or anyone's) is alive.
+  # tools/tpu_rematch.sh holds the SAME lock (chip exclusivity), so this
+  # can briefly read a rematch loop as a live watcher — bounded, not
+  # forever: the rematch loop re-checks the captures-done sentinel every
+  # ~5 min backoff chunk and exits when it is gone (defer_if_new_round);
+  # a bench attempt in flight (up to ~10.5 min) stretches the worst case
+  # to ~15 min.  This supervisor only runs when that sentinel is absent.
   ! flock -n RESULTS/.watcher.lock true 2>/dev/null
 }
 

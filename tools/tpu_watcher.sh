@@ -22,41 +22,23 @@
 # Log: RESULTS/tpu_watch.log
 cd "$(dirname "$0")/.." || exit 1
 LOG=RESULTS/tpu_watch.log
+TAG=watch
+. tools/watch_lib.sh   # bench_running, beat, probe counts, bench_vs_capture, the shared lock path
 
-exec 9>RESULTS/.watcher.lock
+exec 9>"$WATCH_LOCK"
 if ! flock -n 9; then
-  echo "[watch $(date +%T)] another watcher holds the lock; exiting (pid $$)" >> "$LOG"
+  wlog "another watcher/rematch holds the lock; exiting (pid $$)"
   exit 0
 fi
 
-COUNT_FILE=RESULTS/.probe_count
-PROBES=$(cat "$COUNT_FILE" 2>/dev/null || echo 0)
-case "$PROBES" in ''|*[!0-9]*) PROBES=0;; esac
-echo "[watch $(date +%T)] watcher start (pid $$, $PROBES probes carried over)" >> "$LOG"
-
-bench_running() {
-  # A foreground bench (driver bench.py, or the CPU bench tools whose
-  # latency rows concurrent load would poison) is running.  Matching the
-  # cmdline alone is not enough: the session driver's own process quotes
-  # "python bench.py" inside its prompt argument, which made a bare
-  # pgrep match FOREVER and silently starve the watcher of every probe
-  # (caught via the round-5 heartbeat log).  Require argv[0] to be a
-  # python interpreter so only real bench processes count.
-  local p a0
-  for p in $(pgrep -f "bench\.py|speed_runner\.py|hist_ablation\.py" 2>/dev/null); do
-    a0=$(tr '\0' '\n' < "/proc/$p/cmdline" 2>/dev/null | head -1)
-    case "$a0" in
-      *python*) return 0 ;;
-    esac
-  done
-  return 1
-}
+load_probe_count
+wlog "watcher start (pid $$, $PROBES probes carried over)"
 
 promote() {  # promote TMP DST PATTERN — move TMP over DST iff TMP has PATTERN
   local tmp=$1 dst=$2 pat=$3
   if [ -s "$tmp" ] && grep -q "$pat" "$tmp"; then
     mv "$tmp" "$dst"
-    echo "[watch $(date +%T)] promoted $dst" >> "$LOG"
+    wlog "promoted $dst"
   else
     rm -f "$tmp"
   fi
@@ -64,25 +46,15 @@ promote() {  # promote TMP DST PATTERN — move TMP over DST iff TMP has PATTERN
 
 have() { [ -s "$1" ] && grep -q "$2" "$1"; }
 
-LAST_BEAT=$(date +%s)
-beat() {  # emit a heartbeat if ~30 min passed, whatever loop path we're on
-  local now; now=$(date +%s)
-  if [ $((now - LAST_BEAT)) -ge 1800 ]; then
-    echo "[watch $(date +%T)] heartbeat: $1, $PROBES probes so far" >> "$LOG"
-    LAST_BEAT=$now
-  fi
-}
-
 while true; do
   if bench_running; then
     beat "yielding to foreground bench.py"
     sleep 30 9>&-
     continue
   fi
-  PROBES=$((PROBES + 1))
-  echo "$PROBES" > "$COUNT_FILE"
+  count_probe
   if timeout 45 python -c "import jax, jax.numpy as jnp; print(int(jnp.arange(4).sum()))" >/dev/null 2>&1 9>&-; then
-    echo "[watch $(date +%T)] TPU ALIVE — capturing (probe $PROBES)" >> "$LOG"
+    wlog "TPU ALIVE — capturing (probe $PROBES)"
     if ! have RESULTS/hist_ablation_i8_quick.jsonl hist_pallas_i8; then
       bench_running || timeout -k 30 240 python tools/hist_ablation.py --quick \
         --json-out RESULTS/.i8q.tmp >> "$LOG" 2>&1 9>&-
@@ -121,36 +93,20 @@ while true; do
       timeout -k 30 900 python bench.py > RESULTS/.bw2.tmp 2>> "$LOG" 9>&-
       # One three-way decision: 0 = on-chip and better (promote),
       # 1 = on-chip but not better (keep parked, rematch decided),
-      # 2 = never reached the chip (retry next heal).  Top-level platform
-      # is checked by json-parse: a fallback line EMBEDS the parked tpu
-      # capture as last_tpu_capture, so a substring grep would
-      # false-positive on an off-chip run and cancel the rematch forever.
-      python - <<'EOF' 9>&-
-import json, sys
-try:
-    new = json.load(open("RESULTS/.bw2.tmp"))
-except Exception:
-    sys.exit(2)
-if new.get("platform") != "tpu":
-    sys.exit(2)
-try:
-    old = json.load(open("RESULTS/bench_watch.json"))
-except Exception:
-    sys.exit(0)
-sys.exit(0 if new.get("value", 0) > old.get("value", 0) else 1)
-EOF
+      # 2 = never reached the chip (retry next heal).
+      bench_vs_capture RESULTS/.bw2.tmp 9>&-
       case $? in
         0)
           mv RESULTS/.bw2.tmp RESULTS/bench_watch.json
-          echo "[watch $(date +%T)] promoted RESULTS/bench_watch.json (faster re-run)" >> "$LOG"
+          wlog "promoted RESULTS/bench_watch.json (faster re-run)"
           touch RESULTS/.bench_rematch_done ;;
         1)
           rm -f RESULTS/.bw2.tmp
-          echo "[watch $(date +%T)] bench re-run not better; keeping parked capture" >> "$LOG"
+          wlog "bench re-run not better; keeping parked capture"
           touch RESULTS/.bench_rematch_done ;;
         *)
           rm -f RESULTS/.bw2.tmp
-          echo "[watch $(date +%T)] bench re-run never reached the chip; will retry" >> "$LOG" ;;
+          wlog "bench re-run never reached the chip; will retry" ;;
       esac
     fi
     if have RESULTS/hist_ablation_i8.jsonl train_round_fused_i8 && \
@@ -164,10 +120,10 @@ EOF
         RESULTS/bench_watch.json '"platform": "tpu"' \
         RESULTS/final_pass.jsonl train_round_fused_i8_xlafinal \
         > RESULTS/.captures_done
-      echo "[watch $(date +%T)] all captures complete; watcher exiting" >> "$LOG"
+      wlog "all captures complete; watcher exiting"
       exit 0
     fi
-    echo "[watch $(date +%T)] captures incomplete; continuing to poll" >> "$LOG"
+    wlog "captures incomplete; continuing to poll"
   else
     beat "still wedged"
   fi

@@ -1,0 +1,77 @@
+# Shared helpers for the TPU evidence loops (tpu_watcher.sh, tpu_rematch.sh).
+# Source from a script whose cwd is the repo root; the caller must set LOG
+# and TAG (the [watch]/[rematch] log prefix) before sourcing, and pass its
+# flock fd number to the helpers that spawn children (so a kill mid-sleep
+# cannot leave an orphan pinning the lock past the death — callers close
+# the fd themselves with N>&- on every spawn).
+#
+# Both loops take the SAME lock (RESULTS/.watcher.lock): the chip is
+# single-tenant and both loops drive bench.py at it, so they must be
+# mutually exclusive with each other, not just with themselves — a
+# relaunched watcher and a running rematch racing their separate locks was
+# exactly the double-load hazard the watcher's flock exists to prevent.
+
+WATCH_LOCK=RESULTS/.watcher.lock
+COUNT_FILE=RESULTS/.probe_count
+
+wlog() { echo "[$TAG $(date +%T)] $*" >> "$LOG"; }
+
+load_probe_count() {
+  PROBES=$(cat "$COUNT_FILE" 2>/dev/null || echo 0)
+  case "$PROBES" in ''|*[!0-9]*) PROBES=0;; esac
+}
+
+count_probe() {
+  PROBES=$((PROBES + 1))
+  echo "$PROBES" > "$COUNT_FILE"
+}
+
+bench_running() {
+  # A foreground bench (driver bench.py, or the CPU bench tools whose
+  # latency rows concurrent load would poison) is running.  Matching the
+  # cmdline alone is not enough: the session driver's own process quotes
+  # "python bench.py" inside its prompt argument, which made a bare
+  # pgrep match FOREVER and silently starve the watcher of every probe
+  # (caught via the round-5 heartbeat log).  Require argv[0] to be a
+  # python interpreter so only real bench processes count.
+  local p a0
+  for p in $(pgrep -f "bench\.py|speed_runner\.py|hist_ablation\.py|recovery_bench\.py|consensus_bench\.py" 2>/dev/null); do
+    a0=$(tr '\0' '\n' < "/proc/$p/cmdline" 2>/dev/null | head -1)
+    case "$a0" in
+      *python*) return 0 ;;
+    esac
+  done
+  return 1
+}
+
+LAST_BEAT=$(date +%s)
+beat() {  # emit a heartbeat if ~30 min passed, whatever loop path we're on
+  local now; now=$(date +%s)
+  if [ $((now - LAST_BEAT)) -ge 1800 ]; then
+    wlog "heartbeat: $1, $PROBES probes so far"
+    LAST_BEAT=$now
+  fi
+}
+
+# bench_vs_capture TMP — compare a fresh bench line against the parked
+# capture.  Returns 0 = on-chip and faster (caller should promote),
+# 1 = on-chip but not better, 2 = never reached the chip.  Top-level
+# platform is checked by json-parse: a fallback line EMBEDS the parked tpu
+# capture as last_tpu_capture, so a substring grep would false-positive on
+# an off-chip run.
+bench_vs_capture() {
+  BENCH_TMP="$1" python - <<'EOF'
+import json, os, sys
+try:
+    new = json.load(open(os.environ["BENCH_TMP"]))
+except Exception:
+    sys.exit(2)
+if new.get("platform") != "tpu":
+    sys.exit(2)
+try:
+    old = json.load(open("RESULTS/bench_watch.json"))
+except Exception:
+    sys.exit(0)
+sys.exit(0 if new.get("value", 0) > old.get("value", 0) else 1)
+EOF
+}
