@@ -1,0 +1,108 @@
+"""Stochastic whole-job preemption + durable-resume fuzz.
+
+tests/test_durable_ckpt.py covers the durable-spill path with
+DETERMINISTIC whole-job stops (clean ``stop_at`` exits, aligned at a
+commit) plus hand-picked degradations.  Real slice preemptions are
+neither aligned nor polite: every worker dies by SIGKILL at an arbitrary
+instant — some ranks past the commit barrier, some mid-commit, some
+mid-collective, some mid disk write.  Each seed here draws a world size,
+an iteration count, a kill instant with per-rank skew, optional local
+models and checkpoint blobs, and optional post-mortem disk damage (one
+rank's newest file deleted or truncated), SIGKILLs the whole first job at
+those instants, then requires a fresh cluster on the same directory to
+resume and verify every iteration of the self-verifying workload.
+
+The properties under test are the store's crash-atomicity guarantees
+(rabit_tpu/store.py): an interrupted write can never yield a
+readable-but-wrong checkpoint (CRC + atomic rename), the resume
+consensus picks the newest version every rank can be SERVED (holder
+broadcast for missing/torn copies), rank-local state degrades to a
+documented rebuild instead of a crash, and versions stay monotone —
+wherever the kill lands.  The reference has no durable tier at all; this
+fuzzes the beyond-reference surface the way test_fuzz_recover.py fuzzes
+the consensus state machine.
+
+Campaign knobs (mirroring test_fuzz_recover.py): RABIT_FUZZ_DURABLE_SEEDS
+(count, default 15) and RABIT_FUZZ_DURABLE_SEED_BASE (first seed) widen
+the committed CI range for long fuzz campaigns.  A failure names its seed.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import sys
+from pathlib import Path
+
+import pytest
+
+from rabit_tpu.tracker.launcher import LocalCluster
+
+WORKER = str(Path(__file__).parent / "workers" / "recover_worker.py")
+
+N_SEEDS = int(os.environ.get("RABIT_FUZZ_DURABLE_SEEDS", "15"))
+SEED_BASE = int(os.environ.get("RABIT_FUZZ_DURABLE_SEED_BASE", "0"))
+
+
+def draw_scenario(seed: int) -> dict:
+    rng = random.Random(seed)
+    world = rng.randint(2, 4)
+    niter = rng.randint(4, 7)
+    # sleep=0.15 gives every iteration a machine-independent floor so the
+    # kill window spans "before any commit" through "after the last one".
+    base = rng.uniform(0.3, 0.15 * niter + 1.2)
+    return {
+        "world": world,
+        "niter": niter,
+        "use_local": rng.random() < 0.4,
+        "blob": rng.random() < 0.25,
+        # Per-rank skew lands ranks on DIFFERENT sides of a commit barrier
+        # (the skewed-preemption case the aligned stop_at tests cannot hit).
+        "preempt": [(base + rng.uniform(0.0, 0.1), r) for r in range(world)],
+        "damage": rng.choice(["none", "none", "none", "delete", "truncate"]),
+        "damage_rank": rng.randrange(world),
+    }
+
+
+@pytest.mark.parametrize(
+    "seed", range(SEED_BASE, SEED_BASE + N_SEEDS),
+    ids=lambda s: f"seed{s}")
+def test_fuzzed_whole_job_preemption(seed: int, tmp_path):
+    sc = draw_scenario(seed)
+    args = [f"rabit_checkpoint_dir={tmp_path}", f"niter={sc['niter']}",
+            "ndata=1000", "sleep=0.15"]
+    if sc["use_local"]:
+        args.append("local=1")
+    if sc["blob"]:
+        args.append("blob_mb=0.25")
+    cmd = [sys.executable, WORKER, "rabit_engine=robust", *args]
+
+    # Job 1: SIGKILL every rank at its drawn instant.  With no restart
+    # budget the launcher raises on the first observed death and its
+    # cleanup SIGKILLs the remaining ranks — the whole-job preemption
+    # shape.  Any outcome of this job is legal (it may even finish if the
+    # draw outlives the run); the contract under test is entirely about
+    # what job 2 finds on disk.
+    c1 = LocalCluster(sc["world"], max_restarts=0, quiet=True)
+    try:
+        c1.run(cmd, preempt=sc["preempt"], timeout=90.0)
+    except RuntimeError:
+        pass
+
+    files = sorted(tmp_path.glob(f"global_r{sc['damage_rank']}_v*.bin"))
+    if files and sc["damage"] == "delete":
+        files[-1].unlink()
+    elif files and sc["damage"] == "truncate":
+        files[-1].write_bytes(
+            files[-1].read_bytes()[: files[-1].stat().st_size // 2])
+
+    # Job 2: fresh cluster, same directory — must resume wherever the
+    # kills landed and verify every iteration's closed-form results.
+    c2 = LocalCluster(sc["world"], max_restarts=0, quiet=True)
+    rc = c2.run(cmd, timeout=90.0)
+    detail = (f"seed {seed}: {sc}; resume rc={rc} "
+              f"returncodes={c2.returncodes} messages={c2.messages[-6:]}")
+    assert rc == 0 and all(r == 0 for r in c2.returncodes), detail
+    verified = sum(f"all {sc['niter']} iterations verified" in m
+                   for m in c2.messages)
+    assert verified == sc["world"], detail

@@ -40,9 +40,9 @@ defer_if_new_round() {
   fi
 }
 
-backoff() {  # 30 min in sentinel-checking chunks so deferral stays prompt
+backoff() {  # N x 5 min in sentinel-checking chunks so deferral stays prompt
   local i
-  for i in 1 2 3 4 5 6; do
+  for i in $(seq 1 "${1:-6}"); do
     sleep 300 9>&-
     defer_if_new_round
   done
@@ -71,8 +71,13 @@ while true; do
         wlog "re-run not better; keeping current capture"
         backoff ;;
       *)
+        # A completed-but-off-chip run means the tunnel is flapping (the
+        # 45s probe answered, the real program couldn't get on-chip) —
+        # back off 10 min, not 75s, or a half-working tunnel turns this
+        # loop into back-to-back ~10-minute CPU bench runs forever.
         rm -f RESULTS/.bwr.tmp
-        wlog "run never reached the chip; will retry" ;;
+        wlog "run never reached the chip; backing off 10 min"
+        backoff 2 ;;
     esac
   else
     beat "still wedged"
