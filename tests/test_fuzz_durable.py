@@ -51,15 +51,23 @@ def draw_scenario(seed: int) -> dict:
     # sleep=0.15 gives every iteration a machine-independent floor so the
     # kill window spans "before any commit" through "after the last one".
     base = rng.uniform(0.3, 0.15 * niter + 1.2)
+    # local_* damage hits rank-LOCAL state: unlike a damaged global blob
+    # (servable by any holder), a lost local copy has no second source on
+    # disk and must degrade to the documented first-life rebuild instead
+    # of crashing the resume.  A local_* draw forces use_local on so every
+    # such schedule actually exercises that path (an independent draw left
+    # ~60% of them as silent no-ops).
+    damage = rng.choice(["none", "none", "none", "delete", "truncate",
+                         "local_delete", "local_truncate"])
     return {
         "world": world,
         "niter": niter,
-        "use_local": rng.random() < 0.4,
+        "use_local": damage.startswith("local_") or rng.random() < 0.4,
         "blob": rng.random() < 0.25,
         # Per-rank skew lands ranks on DIFFERENT sides of a commit barrier
         # (the skewed-preemption case the aligned stop_at tests cannot hit).
         "preempt": [(base + rng.uniform(0.0, 0.1), r) for r in range(world)],
-        "damage": rng.choice(["none", "none", "none", "delete", "truncate"]),
+        "damage": damage,
         "damage_rank": rng.randrange(world),
     }
 
@@ -89,10 +97,11 @@ def test_fuzzed_whole_job_preemption(seed: int, tmp_path):
     except RuntimeError:
         pass
 
-    files = sorted(tmp_path.glob(f"global_r{sc['damage_rank']}_v*.bin"))
-    if files and sc["damage"] == "delete":
+    kind = "local" if sc["damage"].startswith("local_") else "global"
+    files = sorted(tmp_path.glob(f"{kind}_r{sc['damage_rank']}_v*.bin"))
+    if files and sc["damage"].endswith("delete"):
         files[-1].unlink()
-    elif files and sc["damage"] == "truncate":
+    elif files and sc["damage"].endswith("truncate"):
         files[-1].write_bytes(
             files[-1].read_bytes()[: files[-1].stat().st_size // 2])
 
