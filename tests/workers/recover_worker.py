@@ -119,8 +119,12 @@ def main() -> int:
     elif version > 0:
         # First life yet version > 0: state came off the durable spill
         # (rabit_checkpoint_dir) — the resume tests assert this marker so
-        # they cannot pass vacuously by retraining from scratch.
-        rt.tracker_print(f"[{rank}] resumed from disk at version {version}")
+        # they cannot pass vacuously by retraining from scratch.  The ts
+        # lets tools/recovery_bench.py --resume time the whole-job resume
+        # path the way recovered_at times in-job recovery.
+        rt.tracker_print(
+            f"[{rank}] resumed from disk at version {version} "
+            f"ts={time.time():.6f}")
 
     for it in range(version, niter):
         if pause:

@@ -33,6 +33,9 @@ python tools/recovery_bench.py 2 4 8 16 24 32 48 64 > RESULTS/recovery.jsonl
   python tools/recovery_bench.py 2 8 16 --blob-mb 16
   python tools/recovery_bench.py 4 --blob-mb 64
 } > RESULTS/recovery_blob.jsonl
+{
+  python tools/recovery_bench.py 2 4 8 --resume --blob-mb 0 4 16 64
+} > RESULTS/resume.jsonl
 python tools/sklearn_baseline.py --json-out RESULTS/sklearn_baseline.json
 
 if [[ "${1:-}" == "--tpu" ]]; then

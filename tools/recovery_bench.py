@@ -45,8 +45,11 @@ def run_once(world: int, extra: list[str], timeout: float | None = None):
     recovered from peers (the recovered_at stamp recover_worker prints) —
     the death-detect -> re-bootstrap -> consensus -> checkpoint-serve path
     itself, without Python interpreter startup noise."""
-    cmd = [sys.executable, WORKER, "rabit_engine=mock", "ndata=10000",
-           "niter=3", *extra]
+    # extras FIRST: recover_worker's getarg returns the first k=v match,
+    # so callers' overrides (e.g. resume_sweep's niter=4) must precede the
+    # defaults or they are silently shadowed.
+    cmd = [sys.executable, WORKER, "rabit_engine=mock", *extra,
+           "ndata=10000", "niter=3"]
     cluster = LocalCluster(world, max_restarts=5, quiet=True,
                            extra_env=cpu_worker_env())
     t0 = time.perf_counter()
@@ -157,13 +160,72 @@ def blob_sweep(blob_mbs: list[float], worlds: list[int]) -> None:
             print(json.dumps(rec), flush=True)
 
 
+def resume_sweep(blob_mbs: list[float], worlds: list[int]) -> None:
+    """Whole-job (durable) resume timing — the preemption shape §4's
+    in-job rows cannot see: every worker dies, in-memory state is gone,
+    and a FRESH cluster resumes from the rabit_checkpoint_dir spill.
+
+    Per row: job 1 runs niter=4 and exits cleanly at stop_at=2 (the
+    aligned whole-job stop), job 2 resumes on the same directory and
+    finishes.  resume_latency_s = job-2 launch -> the last rank's
+    resumed-from-disk stamp (spans interpreter boot, bootstrap, the
+    resume consensus, and the per-rank disk read — compare §4's ~0.25 s
+    in-job floor, which shares the boot+bootstrap terms).  fresh_wall_s
+    (the same 4-iteration job from scratch) isolates what resuming COSTS
+    over a cold boot at each payload size; what it SAVES is the skipped
+    iterations, negligible at this toy shape and the whole point at real
+    per-iteration costs."""
+    import tempfile
+
+    for world in worlds:
+        for blob_mb in blob_mbs:
+            blob = [f"blob_mb={blob_mb}"] if blob_mb else []
+            # run_once launches rabit_engine=mock, which with no mock=
+            # kill spec behaves exactly as robust.
+            fresh, _, _, _ = run_once(world, ["niter=4", *blob])
+            with tempfile.TemporaryDirectory() as d:
+                store = [f"rabit_checkpoint_dir={d}"]
+                job1, _, _, _ = run_once(
+                    world, ["niter=4", "stop_at=2", *blob, *store])
+                cmd = [sys.executable, WORKER, "rabit_engine=robust",
+                       "ndata=10000", "niter=4", *blob, *store]
+                cluster = LocalCluster(world, max_restarts=0, quiet=True,
+                                       extra_env=cpu_worker_env())
+                t0w = time.time()
+                t0 = time.perf_counter()
+                rc = cluster.run(cmd, timeout=max(180.0, world * 12.0))
+                wall = time.perf_counter() - t0
+                if rc != 0:
+                    raise RuntimeError(f"resume job failed: {rc}")
+                stamps = [float(m.split("ts=")[1].split()[0])
+                          for m in cluster.messages
+                          if "resumed from disk" in m and "ts=" in m]
+                if len(stamps) != world:
+                    raise RuntimeError(
+                        f"expected {world} resume stamps, got {len(stamps)}")
+            print(json.dumps({
+                "mode": "durable_resume", "world": world,
+                "blob_mb": blob_mb, "resumed_at_version": 2, "niter": 4,
+                "fresh_wall_s": round(fresh, 3),
+                "job1_wall_s": round(job1, 3),
+                "resume_wall_s": round(wall, 3),
+                "resume_latency_s": round(max(stamps) - t0w, 3),
+            }), flush=True)
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("worlds", nargs="*", type=int, default=None)
     ap.add_argument("--blob-mb", nargs="+", type=float, default=None,
                     help="checkpoint-serve scaling mode: blob sizes in MiB")
+    ap.add_argument("--resume", action="store_true",
+                    help="durable whole-job resume timing mode (combine "
+                         "with --blob-mb for payload scaling; blob 0 rows "
+                         "come from plain --resume)")
     args = ap.parse_args()
-    if args.blob_mb:
+    if args.resume:
+        resume_sweep(args.blob_mb or [0.0], args.worlds or [4])
+    elif args.blob_mb:
         blob_sweep(args.blob_mb, args.worlds or [4])
     else:
         world_sweep(args.worlds or [4, 8])
