@@ -44,7 +44,10 @@ from rabit_tpu.models import gbdt  # noqa: E402
 
 
 def getarg(name: str, default: str) -> str:
-    for a in sys.argv[1:]:
+    # Last match wins, matching the config layer's argv semantics
+    # (rabit_tpu/config.py layer 3): a caller can append overrides after
+    # defaults and both the engine and the workload agree on the value.
+    for a in reversed(sys.argv[1:]):
         if a.startswith(name + "="):
             return a.split("=", 1)[1]
     return default

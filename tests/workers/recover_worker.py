@@ -44,7 +44,10 @@ import rabit_tpu as rt
 
 
 def getarg(name: str, default: str) -> str:
-    for a in sys.argv[1:]:
+    # Last match wins, matching the config layer's argv semantics
+    # (rabit_tpu/config.py layer 3): a caller can append overrides after
+    # defaults and both the engine and the workload agree on the value.
+    for a in reversed(sys.argv[1:]):
         if a.startswith(name + "="):
             return a.split("=", 1)[1]
     return default
@@ -88,11 +91,11 @@ def main() -> int:
     else:
         version, model = rt.load_checkpoint()
         lmodel = None
+    first_life = int(os.environ.get("DMLC_NUM_ATTEMPT", "0")) == 0
     if version == 0:
         model = {"iter": 0, "history": []}
         lmodel = {"rank": rank, "iter": 0}
-    elif (use_local and lmodel is None
-          and int(os.environ.get("DMLC_NUM_ATTEMPT", "0")) == 0):
+    elif use_local and lmodel is None and first_life:
         # Documented disk-resume degradation (doc/guide.md, "Surviving
         # whole-job preemption"): a FIRST-LIFE rank killed between the
         # commit barrier and its local disk save resumes at the consensus
@@ -109,7 +112,7 @@ def main() -> int:
               f"blob mismatch at version {version}")
     if use_local:
         check(lmodel["rank"] == rank, f"local model {lmodel} not mine")
-    if int(os.environ.get("DMLC_NUM_ATTEMPT", "0")) > 0:
+    if not first_life:
         # Restarted life: stamp the moment state was recovered from peers
         # (tools/recovery_bench.py diffs this against the launcher's
         # observed death time for protocol-level recovery latency).

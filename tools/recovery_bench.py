@@ -27,6 +27,7 @@ from __future__ import annotations
 import argparse
 import json
 import sys
+import tempfile
 import time
 from pathlib import Path
 
@@ -39,19 +40,25 @@ from rabit_tpu.tracker.launcher import LocalCluster, cpu_worker_env  # noqa: E40
 WORKER = str(REPO / "tests" / "workers" / "recover_worker.py")
 
 
-def run_once(world: int, extra: list[str], timeout: float | None = None):
-    """Returns (wall_s, protocol_latency_s|None).  Protocol latency = from
-    the launcher observing the death to the restarted worker's state being
-    recovered from peers (the recovered_at stamp recover_worker prints) —
-    the death-detect -> re-bootstrap -> consensus -> checkpoint-serve path
-    itself, without Python interpreter startup noise."""
-    # extras FIRST: recover_worker's getarg returns the first k=v match,
-    # so callers' overrides (e.g. resume_sweep's niter=4) must precede the
-    # defaults or they are silently shadowed.
-    cmd = [sys.executable, WORKER, "rabit_engine=mock", *extra,
-           "ndata=10000", "niter=3"]
-    cluster = LocalCluster(world, max_restarts=5, quiet=True,
+def run_once(world: int, extra: list[str], timeout: float | None = None,
+             max_restarts: int = 5):
+    """Returns (wall_s, protocol_latency_s|None, events|None,
+    detect_latency_s|None, resume_latency_s|None).  Protocol latency =
+    from the launcher observing the death to the restarted worker's state
+    being recovered from peers (the recovered_at stamp recover_worker
+    prints) — the death-detect -> re-bootstrap -> consensus ->
+    checkpoint-serve path itself, without Python interpreter startup
+    noise.  Resume latency = launch -> the LAST rank's resumed-from-disk
+    stamp (the whole-job durable-resume path); None unless the run
+    resumed from a rabit_checkpoint_dir spill.  Defaults (mock engine —
+    identical to robust when no mock= kill spec is given — 10k floats,
+    3 iters) are listed first; argv is last-match-wins in both the
+    worker and the engine config, so anything in ``extra`` overrides."""
+    cmd = [sys.executable, WORKER, "rabit_engine=mock", "ndata=10000",
+           "niter=3", *extra]
+    cluster = LocalCluster(world, max_restarts=max_restarts, quiet=True,
                            extra_env=cpu_worker_env())
+    t0w = time.time()
     t0 = time.perf_counter()
     if timeout is None:
         # Scale with world: on an oversubscribed host, wall time grows
@@ -62,6 +69,10 @@ def run_once(world: int, extra: list[str], timeout: float | None = None):
     dt = time.perf_counter() - t0
     if rc != 0 or any(r != 0 for r in cluster.returncodes):
         raise RuntimeError(f"cluster failed: rc={rc} {cluster.returncodes}")
+    resume_stamps = [float(m.split("ts=")[1].split()[0])
+                     for m in cluster.messages
+                     if "resumed from disk" in m and "ts=" in m]
+    resume_latency = (max(resume_stamps) - t0w) if resume_stamps else None
     latency = None
     stamps = [
         float(m.split("recovered_at=")[1].split()[0])
@@ -98,7 +109,7 @@ def run_once(world: int, extra: list[str], timeout: float | None = None):
             events["summary_depth"] = int(fields["summary_depth"])
             events["table_hops"] = int(fields["table_hops"])
         break
-    return dt, latency, events, detect
+    return dt, latency, events, detect, resume_latency
 
 
 def world_sweep(worlds: list[int]) -> None:
@@ -175,41 +186,29 @@ def resume_sweep(blob_mbs: list[float], worlds: list[int]) -> None:
     over a cold boot at each payload size; what it SAVES is the skipped
     iterations, negligible at this toy shape and the whole point at real
     per-iteration costs."""
-    import tempfile
-
+    niter, stop_at = 4, 2
     for world in worlds:
         for blob_mb in blob_mbs:
             blob = [f"blob_mb={blob_mb}"] if blob_mb else []
-            # run_once launches rabit_engine=mock, which with no mock=
-            # kill spec behaves exactly as robust.
-            fresh, _, _, _ = run_once(world, ["niter=4", *blob])
+            fresh = run_once(world, [f"niter={niter}", *blob])[0]
             with tempfile.TemporaryDirectory() as d:
                 store = [f"rabit_checkpoint_dir={d}"]
-                job1, _, _, _ = run_once(
-                    world, ["niter=4", "stop_at=2", *blob, *store])
-                cmd = [sys.executable, WORKER, "rabit_engine=robust",
-                       "ndata=10000", "niter=4", *blob, *store]
-                cluster = LocalCluster(world, max_restarts=0, quiet=True,
-                                       extra_env=cpu_worker_env())
-                t0w = time.time()
-                t0 = time.perf_counter()
-                rc = cluster.run(cmd, timeout=max(180.0, world * 12.0))
-                wall = time.perf_counter() - t0
-                if rc != 0:
-                    raise RuntimeError(f"resume job failed: {rc}")
-                stamps = [float(m.split("ts=")[1].split()[0])
-                          for m in cluster.messages
-                          if "resumed from disk" in m and "ts=" in m]
-                if len(stamps) != world:
-                    raise RuntimeError(
-                        f"expected {world} resume stamps, got {len(stamps)}")
+                job1 = run_once(
+                    world, [f"niter={niter}", f"stop_at={stop_at}",
+                            *blob, *store])[0]
+                wall, _, _, _, resume_latency = run_once(
+                    world, [f"niter={niter}", *blob, *store],
+                    max_restarts=0)
+                if resume_latency is None:
+                    raise RuntimeError("job 2 did not resume from disk")
             print(json.dumps({
                 "mode": "durable_resume", "world": world,
-                "blob_mb": blob_mb, "resumed_at_version": 2, "niter": 4,
+                "blob_mb": blob_mb, "resumed_at_version": stop_at,
+                "niter": niter,
                 "fresh_wall_s": round(fresh, 3),
                 "job1_wall_s": round(job1, 3),
                 "resume_wall_s": round(wall, 3),
-                "resume_latency_s": round(max(stamps) - t0w, 3),
+                "resume_latency_s": round(resume_latency, 3),
             }), flush=True)
 
 
