@@ -21,12 +21,11 @@ from typing import Any, Callable
 
 import numpy as np
 
+from rabit_tpu import obs
 from rabit_tpu.config import Config
 from rabit_tpu.engine import create_engine
 from rabit_tpu.engine.base import MAX, MIN, SUM, BITOR, DTYPE_ENUM, Engine
-import time
-
-from rabit_tpu.profile import GLOBAL_STATS, CollectiveStats, OpStats
+from rabit_tpu.profile import GLOBAL_STATS, CollectiveStats
 
 _engine: Engine | None = None
 # Durable-spill state (rabit_checkpoint_dir): the store, and the user-visible
@@ -58,7 +57,9 @@ def _unwrap(blob: bytes) -> tuple[int, bytes]:
 def collective_stats() -> CollectiveStats:
     """Accumulated per-collective timing for this process (see
     rabit_tpu.profile; the Python-layer analogue of the reference's
-    rabit_debug/report_stats observability)."""
+    rabit_debug/report_stats observability).  The full registry — named
+    counters/gauges/histograms — is ``collective_stats().registry`` or
+    ``rabit_tpu.obs.get_registry()``."""
     return GLOBAL_STATS
 
 
@@ -106,6 +107,15 @@ def init(args: list[str] | None = None, **overrides: Any) -> None:
     cfg = Config(args, {k: str(v) for k, v in overrides.items()})
     _engine = create_engine(cfg)
     _engine.init()
+    # Observability wiring: flight recorder capacity, hang/SIGTERM dump
+    # paths (RABIT_OBS_DIR), metric shipping identity (see rabit_tpu.obs).
+    obs.configure(cfg, rank=_engine.get_rank())
+    obs.record_event(
+        "engine_ready",
+        engine=type(_engine).__name__,
+        rank=_engine.get_rank(),
+        world=_engine.get_world_size(),
+    )
     global _ckpt_store, _ckpt_base
     _ckpt_base = 0
     ckpt_dir = cfg.get("rabit_checkpoint_dir", "") or ""
@@ -118,9 +128,13 @@ def init(args: list[str] | None = None, **overrides: Any) -> None:
 
 
 def finalize() -> None:
-    """Shut down the engine (reference: RabitFinalize)."""
+    """Shut down the engine (reference: RabitFinalize).  Ships the final
+    metrics snapshot to the tracker first — the tracker keeps serving until
+    every rank's shutdown handshake, so the snapshot always lands."""
     global _engine, _ckpt_store, _ckpt_base
     if _engine is not None:
+        obs.ship_final_snapshot()
+        obs.record_event("engine_finalize", engine=type(_engine).__name__)
         _engine.shutdown()
         _engine = None
     _ckpt_store = None
@@ -161,12 +175,15 @@ def broadcast(data: Any, root: int) -> Any:
         if data is None:
             raise ValueError("need to pass in data when broadcasting")
         payload = pickle.dumps(data, protocol=pickle.HIGHEST_PROTOCOL)
-    t0 = time.perf_counter()
-    out = engine.broadcast(payload, root, cache_key=key)
-    nbytes = len(payload) if payload is not None else len(out) if out else 0
-    GLOBAL_STATS.ops.setdefault("broadcast", OpStats()).add(
-        nbytes, time.perf_counter() - t0
-    )
+    # Same timed/evented path as allreduce/allgather; a non-root only
+    # learns the payload length from the wire, so the span's byte count is
+    # set inside the window.
+    with obs.collective(
+        "broadcast", len(payload) if payload is not None else 0, cache_key=key
+    ) as span:
+        out = engine.broadcast(payload, root, cache_key=key)
+        span.nbytes = (len(payload) if payload is not None
+                       else len(out) if out else 0)
     return data if rank == root else pickle.loads(out)
 
 
@@ -197,9 +214,10 @@ def allreduce(
     # NOTE: the timed window includes a lazy prepare_fun's execution (it
     # runs inside the engine, interleaved with recovery decisions), so
     # expensive preparation shows up as allreduce latency in the stats.
-    with GLOBAL_STATS.timed("allreduce", buf.nbytes):
+    key = _caller_key()
+    with obs.collective("allreduce", buf.nbytes, cache_key=key):
         out = _get_engine().allreduce(
-            buf, op, prepare_fun=prepare_fun, cache_key=_caller_key()
+            buf, op, prepare_fun=prepare_fun, cache_key=key
         )
     return np.asarray(out).reshape(shape)
 
@@ -211,8 +229,9 @@ def allgather(data: np.ndarray) -> np.ndarray:
         raise TypeError("allgather only takes numpy ndarrays")
     engine = _get_engine()
     flat = np.ascontiguousarray(data).reshape(-1)
-    with GLOBAL_STATS.timed("allgather", flat.nbytes):
-        out = engine.allgather(flat, cache_key=_caller_key())
+    key = _caller_key()
+    with obs.collective("allgather", flat.nbytes, cache_key=key):
+        out = engine.allgather(flat, cache_key=key)
     return np.asarray(out).reshape((engine.get_world_size(),) + data.shape)
 
 
@@ -294,11 +313,25 @@ def load_checkpoint(with_local: bool = False):
             # process state starts empty).
             _ckpt_base, gblob = _unwrap(gblob)
             version = _ckpt_base + version
+    obs.record_event("load_checkpoint", version=version,
+                     recovered=version > 0)
+    if version > 0:
+        obs.get_registry().counter("load_checkpoint_recovered_total").inc()
     gmodel = pickle.loads(gblob) if version > 0 and gblob is not None else None
     if with_local:
         lmodel = pickle.loads(lblob) if version > 0 and lblob is not None else None
         return version, gmodel, lmodel
     return version, gmodel
+
+
+def _note_commit(engine: Engine, nbytes: int) -> None:
+    """Record one checkpoint commit (engine version bump) in the flight
+    recorder and registry."""
+    version = _ckpt_base + engine.version_number()
+    obs.record_event("checkpoint_commit", version=version, nbytes=nbytes)
+    reg = obs.get_registry()
+    reg.counter("checkpoint_commits_total").inc()
+    reg.gauge("checkpoint_version").set(version)
 
 
 def checkpoint(global_model: Any, local_model: Any = None) -> None:
@@ -312,9 +345,11 @@ def checkpoint(global_model: Any, local_model: Any = None) -> None:
     engine = _get_engine()
     if _ckpt_store is None:
         engine.checkpoint(gblob, lblob)
+        _note_commit(engine, len(gblob))
         return
     wrapped = _wrap(_ckpt_base, gblob)
     engine.checkpoint(wrapped, lblob)
+    _note_commit(engine, len(wrapped))
     # Persist AFTER the commit barrier: live ranks' disk versions can then
     # skew by at most one, which the store's keep-2 retention covers.
     _ckpt_store.save(_ckpt_base + engine.version_number(), wrapped, lblob)
@@ -333,9 +368,11 @@ def lazy_checkpoint(global_model: Any) -> None:
     if _ckpt_store is not None:
         checkpoint(global_model)
         return
-    _get_engine().lazy_checkpoint(
+    engine = _get_engine()
+    engine.lazy_checkpoint(
         lambda: pickle.dumps(global_model, protocol=pickle.HIGHEST_PROTOCOL)
     )
+    _note_commit(engine, 0)  # lazy: bytes unknown unless a failure asks
 
 
 def version_number() -> int:

@@ -23,6 +23,7 @@ _ENV_KEYS = [
     "DMLC_ROLE",
     "DMLC_NUM_ATTEMPT",
     "DMLC_WORKER_CONNECT_RETRY",
+    "RABIT_OBS_DIR",
     "rabit_global_replica",
     "rabit_local_replica",
 ]
@@ -35,6 +36,7 @@ _ENV_TO_KEY = {
     "DMLC_ROLE": "rabit_role",
     "DMLC_NUM_ATTEMPT": "rabit_num_trial",
     "DMLC_WORKER_CONNECT_RETRY": "rabit_connect_retry",
+    "RABIT_OBS_DIR": "rabit_obs_dir",
 }
 
 _UNIT = {"B": 1, "K": 1 << 10, "M": 1 << 20, "G": 1 << 30}
@@ -66,6 +68,17 @@ DEFAULTS: dict[str, str] = {
     # version (whole-job preemption durability; rabit_tpu/store.py).
     "rabit_checkpoint_dir": "",
     "rabit_debug": "0",
+    # Observability (rabit_tpu/obs, doc/observability.md): when
+    # rabit_obs_dir (or the RABIT_OBS_DIR env var) is set, each rank dumps
+    # its flight recorder there on SIGTERM or when a collective is stuck
+    # longer than rabit_obs_hang_sec, and the tracker writes the job-level
+    # telemetry.json there.  rabit_obs_heartbeat_sec > 0 additionally
+    # ships periodic metric snapshots to the tracker (shutdown always
+    # ships one).
+    "rabit_obs_dir": "",
+    "rabit_obs_capacity": "2048",
+    "rabit_obs_hang_sec": "300",
+    "rabit_obs_heartbeat_sec": "0",
     # Default ON, matching the native engine (see comm.cc Configure): with
     # Nagle on, every cold-direction header write stalls ~40ms behind the
     # peer's delayed ACK — measured 44ms/op on loopback object broadcasts.

@@ -61,6 +61,14 @@ class Engine(ABC):
     def __init__(self, config: Config):
         self.config = config
 
+    def obs_event(self, kind: str, /, **fields):
+        """Record a structured engine-layer event into the process flight
+        recorder (rabit_tpu.obs), tagged with the backend class.  Lazy
+        import: base must stay importable before the obs package."""
+        from rabit_tpu import obs
+
+        return obs.record_event(kind, engine=type(self).__name__, **fields)
+
     # -- lifecycle ---------------------------------------------------------
 
     def init(self) -> None:

@@ -111,9 +111,13 @@ class NativeEngine(Engine):
 
     def _check(self, rc: int, what: str) -> None:
         if rc != 0:
-            raise NativeError(
-                f"{what} failed: {self._lib.TrtGetLastError().decode()}"
-            )
+            msg = self._lib.TrtGetLastError().decode()
+            # Bridge-side evidence: the error (mock kill, socket failure,
+            # recovery abort) lands in the flight recorder before the
+            # exception unwinds Python — a subsequent hang/SIGTERM dump
+            # then carries it.
+            self.obs_event("engine_error", what=what, error=msg)
+            raise NativeError(f"{what} failed: {msg}")
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -123,12 +127,24 @@ class NativeEngine(Engine):
             cfg["rabit_engine"] = self._kind
         args = [f"{k}={v}".encode() for k, v in cfg.items()]
         arr = (ctypes.c_char_p * len(args))(*args)
+        self.obs_event("engine_init", backend=self._kind)
         self._check(self._lib.RabitInit(len(args), arr), "init")
+        # (Re)bootstrap complete: the assignment is live.  Restarted lives
+        # see DMLC_NUM_ATTEMPT > 0 — the recorder then shows the reconnect
+        # wave this rank came back through.
+        self.obs_event(
+            "bootstrap_done",
+            rank=self.get_rank(),
+            world=self.get_world_size(),
+            attempt=int(os.environ.get("DMLC_NUM_ATTEMPT", "0") or "0"),
+        )
 
     def shutdown(self) -> None:
+        self.obs_event("engine_shutdown", backend=self._kind)
         self._check(self._lib.RabitFinalize(), "finalize")
 
     def init_after_exception(self) -> None:
+        self.obs_event("init_after_exception", backend=self._kind)
         self._check(self._lib.RabitInitAfterException(), "init_after_exception")
 
     # -- topology ----------------------------------------------------------
@@ -258,6 +274,15 @@ class NativeEngine(Engine):
             return 0, None, None
         gblob = ctypes.string_at(gptr, glen.value) if glen.value else None
         lblob = ctypes.string_at(lptr, llen.value) if llen.value else None
+        # Recovery phase evidence at the bridge: a version > 0 load means
+        # this life's state was served by peers (the robust engine's
+        # recover_stats print carries the protocol counters; the tracker
+        # converts that line into a structured event — see
+        # rabit_tpu.obs.events.event_from_stats_line).
+        self.obs_event(
+            "checkpoint_loaded", version=version,
+            global_bytes=glen.value, local_bytes=llen.value,
+        )
         return version, gblob, lblob
 
     def checkpoint(self, global_blob, local_blob=None):
@@ -268,6 +293,7 @@ class NativeEngine(Engine):
             ),
             "checkpoint",
         )
+        self.obs_event("version_bump", version=self.version_number())
 
     def lazy_checkpoint(self, get_global_blob: Callable[[], bytes]) -> None:
         # True lazy across the ABI (reference global_lazycheck,

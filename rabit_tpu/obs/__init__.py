@@ -1,0 +1,271 @@
+"""rabit_tpu.obs — per-rank observability: flight recorder + metrics.
+
+Three pieces (ISSUE 1 tentpole):
+
+* a per-rank **flight recorder** (events.py) — bounded ring of structured
+  events: op begin/end with cache_key/nbytes, bootstrap/recovery phases,
+  checkpoint commits, engine lifecycle;
+* a **metrics registry** (metrics.py) — thread-safe counters / gauges /
+  latency histograms subsuming the old ``CollectiveStats``;
+* **shipping** (ship.py) — workers send metric snapshots to the tracker
+  (``CMD_METRICS``) on shutdown/heartbeat; the tracker writes a job-level
+  ``telemetry.json``.
+
+This module owns the process-wide singletons and the failure paths: when
+``RABIT_OBS_DIR`` (or ``rabit_obs_dir=``) is configured, a SIGTERM or a
+collective stuck past ``rabit_obs_hang_sec`` dumps the flight recorder to
+``<dir>/flight-rank<R>-pid<P>-<reason>.jsonl`` (NCCL-flight-recorder
+style), so hangs produce evidence instead of silence.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import signal
+import threading
+import time
+
+from rabit_tpu.obs.events import (  # noqa: F401 (re-exports)
+    DEFAULT_CAPACITY,
+    Event,
+    FlightRecorder,
+    event_from_stats_line,
+    events_from_lines,
+    load_dump,
+)
+from rabit_tpu.obs.metrics import (  # noqa: F401 (re-exports)
+    GLOBAL_REGISTRY,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    OpStats,
+    _Span,
+)
+from rabit_tpu.obs import ship as _ship
+
+#: Process-wide flight recorder (engine + api layers record into it).
+GLOBAL_RECORDER = FlightRecorder()
+
+
+def get_recorder() -> FlightRecorder:
+    return GLOBAL_RECORDER
+
+
+def get_registry() -> MetricsRegistry:
+    return GLOBAL_REGISTRY
+
+
+def record_event(kind: str, /, **fields) -> Event:
+    """Record one structured event into the process flight recorder."""
+    return GLOBAL_RECORDER.record(kind, **fields)
+
+
+# -- process obs state -------------------------------------------------------
+
+class _ObsState:
+    """Mutable per-process configuration filled in by ``configure``."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.obs_dir: str = ""
+        self.hang_sec: float = 300.0
+        self.rank: int = -1
+        self.task_id: str = ""
+        self.tracker: tuple[str, int] | None = None
+        self.heartbeat: _ship.Heartbeat | None = None
+        self.watchdog_started = False
+        self.sigterm_installed = False
+        self.prev_sigterm = None
+        self.hang_dumped = False
+        # thread-id -> (op, cache_key, t0_monotonic) of in-flight collectives
+        self.inflight: dict[int, tuple[str, str | None, float]] = {}
+
+
+_STATE = _ObsState()
+
+
+def configure(config, rank: int = -1) -> None:
+    """Wire observability from the engine config.  Called by
+    ``rabit_tpu.init`` after the engine is up (and safe to call again on a
+    later init: singletons persist, identity/settings are refreshed).
+
+    Keys (doc/observability.md): ``rabit_obs_dir`` (also the plain
+    ``RABIT_OBS_DIR`` env var), ``rabit_obs_capacity``,
+    ``rabit_obs_hang_sec``, ``rabit_obs_heartbeat_sec``.
+    """
+    obs_dir = (config.get("rabit_obs_dir", "") or
+               os.environ.get("RABIT_OBS_DIR", "") or "")
+    if obs_dir == "NULL":
+        obs_dir = ""
+    capacity = config.get_int("rabit_obs_capacity", DEFAULT_CAPACITY)
+    hang_sec = float(config.get("rabit_obs_hang_sec", "300") or "300")
+    heartbeat_sec = float(config.get("rabit_obs_heartbeat_sec", "0") or "0")
+    tracker_uri = config.get("rabit_tracker_uri", "NULL")
+    task_id = config.get("rabit_task_id", "NULL") or "NULL"
+
+    GLOBAL_RECORDER.set_capacity(capacity)
+    with _STATE.lock:
+        _STATE.obs_dir = obs_dir
+        _STATE.hang_sec = hang_sec
+        _STATE.rank = rank
+        _STATE.task_id = task_id
+        _STATE.tracker = None
+        if tracker_uri and tracker_uri != "NULL":
+            _STATE.tracker = (
+                tracker_uri, config.get_int("rabit_tracker_port", 9091)
+            )
+    if obs_dir:
+        os.makedirs(obs_dir, exist_ok=True)
+        _install_sigterm_dump()
+        if hang_sec > 0:
+            _start_hang_watchdog()
+    if heartbeat_sec > 0 and _STATE.tracker is not None:
+        stop_heartbeat()
+        hb = _ship.Heartbeat(
+            heartbeat_sec, _make_snapshot,
+            _STATE.tracker[0], _STATE.tracker[1], task_id,
+        ).start()
+        with _STATE.lock:
+            _STATE.heartbeat = hb
+
+
+# -- collective spans --------------------------------------------------------
+
+@contextlib.contextmanager
+def collective(op: str, nbytes: int, cache_key: str | None = None):
+    """The one timing/eventing path for every public collective: records
+    ``op_begin``/``op_end`` events, marks the thread in-flight for the hang
+    watchdog, and times into the registry's per-op stats + latency
+    histogram.  Yields a span whose ``nbytes`` may be updated inside the
+    window (object broadcast learns its length from the wire)."""
+    tid = threading.get_ident()
+    record_event("op_begin", op=op, nbytes=nbytes, cache_key=cache_key)
+    with _STATE.lock:
+        _STATE.inflight[tid] = (op, cache_key, time.monotonic())
+    t0 = time.perf_counter()
+    span = _Span(op, nbytes, cache_key)
+    try:
+        yield span
+    finally:
+        dt = time.perf_counter() - t0
+        with _STATE.lock:
+            _STATE.inflight.pop(tid, None)
+        GLOBAL_REGISTRY.observe_op(op, span.nbytes, dt)
+        record_event("op_end", op=op, nbytes=span.nbytes,
+                     cache_key=cache_key, seconds=round(dt, 6))
+
+
+# -- failure-path dumps ------------------------------------------------------
+
+def dump_now(reason: str) -> str | None:
+    """Dump the flight recorder to the configured obs dir; returns the path
+    (None when no dir is configured).  Never raises."""
+    with _STATE.lock:
+        obs_dir, rank = _STATE.obs_dir, _STATE.rank
+        inflight = list(_STATE.inflight.values())
+    if not obs_dir:
+        return None
+    try:
+        for op, key, t0 in inflight:
+            record_event("op_inflight", op=op, cache_key=key,
+                         stuck_seconds=round(time.monotonic() - t0, 3))
+        path = os.path.join(
+            obs_dir, f"flight-rank{rank}-pid{os.getpid()}-{reason}.jsonl"
+        )
+        return GLOBAL_RECORDER.dump(
+            path, header={"reason": reason, "rank": rank,
+                          "task_id": _STATE.task_id}
+        )
+    except OSError:
+        return None
+
+
+def _on_sigterm(signum, frame):
+    dump_now("sigterm")
+    prev = _STATE.prev_sigterm
+    if callable(prev):
+        prev(signum, frame)
+        return
+    # restore the previous disposition and re-deliver so the process still
+    # dies with the normal SIGTERM exit status
+    signal.signal(signal.SIGTERM, prev if prev is not None else signal.SIG_DFL)
+    os.kill(os.getpid(), signal.SIGTERM)
+
+
+def _install_sigterm_dump() -> None:
+    with _STATE.lock:
+        if _STATE.sigterm_installed:
+            return
+        _STATE.sigterm_installed = True
+    try:
+        _STATE.prev_sigterm = signal.signal(signal.SIGTERM, _on_sigterm)
+    except ValueError:
+        # not the main thread — the watchdog still covers hangs
+        with _STATE.lock:
+            _STATE.sigterm_installed = False
+
+
+def _watchdog_loop() -> None:
+    while True:
+        with _STATE.lock:
+            hang_sec = _STATE.hang_sec
+            obs_dir = _STATE.obs_dir
+            dumped = _STATE.hang_dumped
+            stuck = None
+            if hang_sec > 0:
+                now = time.monotonic()
+                for op, key, t0 in _STATE.inflight.values():
+                    if now - t0 > hang_sec:
+                        stuck = (op, key, now - t0)
+                        break
+        if obs_dir and not dumped and stuck is not None:
+            record_event("hang_detected", op=stuck[0], cache_key=stuck[1],
+                         stuck_seconds=round(stuck[2], 3))
+            dump_now("hang")
+            with _STATE.lock:
+                _STATE.hang_dumped = True
+        time.sleep(min(1.0, hang_sec / 4.0) if hang_sec > 0 else 1.0)
+
+
+def _start_hang_watchdog() -> None:
+    with _STATE.lock:
+        if _STATE.watchdog_started:
+            return
+        _STATE.watchdog_started = True
+    threading.Thread(
+        target=_watchdog_loop, name="rabit-obs-watchdog", daemon=True
+    ).start()
+
+
+# -- shutdown shipping -------------------------------------------------------
+
+def _make_snapshot() -> dict:
+    with _STATE.lock:
+        rank, task_id = _STATE.rank, _STATE.task_id
+    return _ship.build_snapshot(
+        GLOBAL_REGISTRY, rank, task_id,
+        extra={"flight_dropped": GLOBAL_RECORDER.dropped},
+    )
+
+
+def stop_heartbeat() -> None:
+    with _STATE.lock:
+        hb, _STATE.heartbeat = _STATE.heartbeat, None
+    if hb is not None:
+        hb.stop()
+
+
+def ship_final_snapshot() -> bool:
+    """Ship the shutdown-time snapshot to the tracker (best-effort; False
+    when no tracker is configured or the send failed).  Called by
+    ``rabit_tpu.finalize`` BEFORE the engine's own shutdown handshake so
+    the tracker is still serving when the snapshot arrives."""
+    stop_heartbeat()
+    with _STATE.lock:
+        tracker, task_id = _STATE.tracker, _STATE.task_id
+    if tracker is None:
+        return False
+    return _ship.ship_snapshot(_make_snapshot(), tracker[0], tracker[1],
+                               task_id)

@@ -1,0 +1,83 @@
+"""Worker-side metric shipping over the tracker protocol.
+
+Workers ship their metrics snapshot to the tracker as a ``CMD_METRICS``
+message (a JSON string on the same framed wire as ``CMD_PRINT``, see
+rabit_tpu/tracker/protocol.py) — on shutdown always, and periodically when
+``rabit_obs_heartbeat_sec`` > 0.  The tracker aggregates the latest
+snapshot per rank into the job-level ``telemetry.json``.
+
+Everything here is best-effort: observability must never fail a job, so a
+dead tracker or refused connection is swallowed (and counted on the
+registry so it is still visible in the next successful ship).
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+from typing import Callable
+
+from rabit_tpu.tracker import protocol as P
+
+#: Current snapshot envelope version (bump on incompatible change).
+SNAPSHOT_SCHEMA = 1
+
+
+def build_snapshot(registry, rank: int, task_id: str, host: str = "",
+                   extra: dict | None = None) -> dict:
+    """The JSON envelope a worker ships: identity + full registry state."""
+    snap = {
+        "schema": SNAPSHOT_SCHEMA,
+        "rank": rank,
+        "task_id": task_id,
+        "host": host,
+        "metrics": registry.snapshot(),
+    }
+    if extra:
+        snap.update(extra)
+    return snap
+
+
+def ship_snapshot(snapshot: dict, tracker_host: str, tracker_port: int,
+                  task_id: str, timeout: float = 5.0) -> bool:
+    """Send one snapshot; True on ACK.  Raises nothing."""
+    try:
+        with socket.create_connection(
+            (tracker_host, int(tracker_port)), timeout=timeout
+        ) as sock:
+            P.send_hello(sock, P.CMD_METRICS, task_id,
+                         message=json.dumps(snapshot))
+            return P.get_u32(sock) == P.ACK
+    except (OSError, ValueError):
+        return False
+
+
+class Heartbeat:
+    """Daemon thread shipping a fresh snapshot every ``interval`` seconds
+    until stopped.  ``make_snapshot`` is called on the heartbeat thread —
+    the registry is thread-safe by contract."""
+
+    def __init__(self, interval: float, make_snapshot: Callable[[], dict],
+                 tracker_host: str, tracker_port: int, task_id: str):
+        self._interval = max(float(interval), 0.05)
+        self._make_snapshot = make_snapshot
+        self._addr = (tracker_host, int(tracker_port))
+        self._task_id = task_id
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="rabit-obs-heartbeat", daemon=True
+        )
+
+    def start(self) -> "Heartbeat":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=2.0)
+
+    def _run(self) -> None:
+        while not self._stop.wait(self._interval):
+            ship_snapshot(self._make_snapshot(), self._addr[0], self._addr[1],
+                          self._task_id)

@@ -1,11 +1,22 @@
-"""Tracing / profiling (SURVEY §5 aux subsystems).
+"""Tracing / profiling (SURVEY §5 aux subsystems) — legacy facade.
 
 The reference's observability is (a) ``rabit_debug=1`` per-op latency log
 lines (allreduce_robust.cc:214-217,289-294) and (b) the mock engine's
 per-checkpoint-interval timing totals (allreduce_mock.h:56-77).  The TPU
-build keeps both ideas at the API layer — every collective is timed into a
-process-wide ``CollectiveStats`` — and adds the TPU-native piece: a thin
-wrapper over the XLA profiler for device traces.
+build's observability now lives in :mod:`rabit_tpu.obs` — a thread-safe
+metrics registry (counters/gauges/latency histograms) plus a flight
+recorder of structured events.  This module keeps the historical surface:
+
+* ``CollectiveStats`` / ``OpStats`` / ``GLOBAL_STATS`` — now thin views
+  over the process-wide :data:`rabit_tpu.obs.GLOBAL_REGISTRY`, so existing
+  callers (``rt.collective_stats().report()``) keep working and gain
+  thread safety + histogram percentiles for free;
+* ``parse_stats_line`` / ``is_recovery_stats_line`` — the stdout-line
+  parsers, kept so historical logs remain readable.  **Deprecated** for
+  live consumption: the tracker now converts the robust engine's
+  ``recover_stats`` / ``failure_detected`` prints into structured events
+  (``LocalCluster.events``, ``telemetry.json``) — new code should consume
+  those instead of scraping stdout (see doc/observability.md).
 
 Usage:
 
@@ -21,80 +32,47 @@ Usage:
 from __future__ import annotations
 
 import contextlib
-import time
-from dataclasses import dataclass, field
+
+from rabit_tpu.obs.events import (  # noqa: F401  (deprecated re-exports)
+    is_recovery_stats_line,
+    parse_stats_line,
+)
+from rabit_tpu.obs.metrics import GLOBAL_REGISTRY, MetricsRegistry, OpStats
 
 
-@dataclass
-class OpStats:
-    calls: int = 0
-    nbytes: int = 0
-    seconds: float = 0.0
-    max_seconds: float = 0.0
-
-    def add(self, nbytes: int, seconds: float) -> None:
-        self.calls += 1
-        self.nbytes += nbytes
-        self.seconds += seconds
-        self.max_seconds = max(self.max_seconds, seconds)
-
-
-@dataclass
 class CollectiveStats:
-    """Per-operation accumulated timing, the Python-layer analogue of the
-    mock engine's tsum_allreduce/tsum_allgather counters."""
+    """Per-operation accumulated timing — the historical facade, now backed
+    by a thread-safe :class:`rabit_tpu.obs.MetricsRegistry`.  A bare
+    ``CollectiveStats()`` gets its own private registry; ``GLOBAL_STATS``
+    shares the process-wide one that ``rabit_tpu.api`` times into."""
 
-    ops: dict[str, OpStats] = field(default_factory=dict)
+    def __init__(self, registry: MetricsRegistry | None = None):
+        self._registry = registry if registry is not None else MetricsRegistry()
 
-    @contextlib.contextmanager
+    @property
+    def registry(self) -> MetricsRegistry:
+        return self._registry
+
+    @property
+    def ops(self) -> dict[str, OpStats]:
+        return self._registry.ops
+
     def timed(self, op: str, nbytes: int):
-        t0 = time.perf_counter()
-        try:
-            yield
-        finally:
-            self.ops.setdefault(op, OpStats()).add(
-                nbytes, time.perf_counter() - t0
-            )
+        """Context manager timing one collective (delegates to the
+        registry; also feeds the per-op latency histogram)."""
+        return self._registry.timed(op, nbytes)
 
     def reset(self) -> None:
-        self.ops.clear()
+        self._registry.reset()
 
     def report(self) -> str:
-        """One line per op: count, volume, mean/max latency, bandwidth."""
-        lines = []
-        for op in sorted(self.ops):
-            s = self.ops[op]
-            mean_ms = 1e3 * s.seconds / max(s.calls, 1)
-            bw = s.nbytes / s.seconds / 2**20 if s.seconds > 0 else 0.0
-            lines.append(
-                f"{op}: {s.calls} calls, {s.nbytes / 2**20:.2f} MiB, "
-                f"mean {mean_ms:.3f} ms, max {1e3 * s.max_seconds:.3f} ms, "
-                f"{bw:.1f} MiB/s"
-            )
-        return "\n".join(lines) if lines else "(no collectives recorded)"
+        """One line per op: count, volume, mean/max latency, bandwidth,
+        and latency percentiles."""
+        return self._registry.report()
 
 
 #: process-wide collector used by rabit_tpu.api
-GLOBAL_STATS = CollectiveStats()
-
-
-def parse_stats_line(line: str) -> dict[str, str]:
-    """Parse a ``key=value``-style tracker line (the robust engine's
-    ``recover_stats`` / ``recover_stats_final`` observability prints) into a
-    dict.  One parser for every consumer (recovery/consensus benches, tests)
-    so a stats-line format change has a single point of truth."""
-    return dict(p.split("=", 1) for p in line.split() if "=" in p)
-
-
-def is_recovery_stats_line(line: str) -> bool:
-    """True for a recovered life's per-recovery ``recover_stats`` line from
-    LoadCheckPoint — the line whose counters the recovery bench and tests
-    consume.  Excludes the shutdown-time ``recover_stats_final`` lines
-    (shared prefix, no per-recovery fields) and first lives (version=0).
-    The companion predicate to :func:`parse_stats_line`, kept here for the
-    same reason: one point of truth for the line format."""
-    return ("recover_stats " in line and "recover_stats_final" not in line
-            and "version=0 " not in line)
+GLOBAL_STATS = CollectiveStats(registry=GLOBAL_REGISTRY)
 
 
 @contextlib.contextmanager

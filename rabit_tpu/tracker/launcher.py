@@ -54,6 +54,12 @@ class LocalCluster:
         self.restarts = [0] * num_workers
         self.returncodes: list[int | None] = [None] * num_workers
         self.messages: list[str] = []  # tracker print log of the last run
+        # Structured observability of the last run (doc/observability.md):
+        # tracker events (bootstrap/recovery waves, recover_stats converted
+        # from prints) and the job-level telemetry document — what tools/
+        # consume instead of scraping self.messages.
+        self.events: list[dict] = []
+        self.telemetry: dict | None = None
         # time.time() at each observed worker death (recovery-latency
         # benchmarks diff these against worker-reported recovery stamps)
         self.death_times: list[float] = []
@@ -92,6 +98,7 @@ class LocalCluster:
         death."""
         tracker = Tracker(self.num_workers, quiet=self.quiet).start()
         self.messages = tracker.messages
+        self.events = tracker.events
         procs = [self._spawn(cmd, tracker, i) for i in range(self.num_workers)]
         start = time.monotonic()
         deadline = start + timeout
@@ -170,7 +177,8 @@ class LocalCluster:
                 if proc is not None and proc.poll() is None:
                     proc.kill()
                     proc.wait()
-            tracker.stop()
+            tracker.stop()  # also flushes telemetry.json (idempotent)
+            self.telemetry = tracker.telemetry
 
 
 def main(argv: list[str] | None = None) -> int:

@@ -12,11 +12,14 @@ Message layout (all u32/i32 little-endian; strings are u32 length + utf-8):
 
 worker -> tracker (fresh connection per message):
     u32 MAGIC_HELLO
-    u32 cmd          (CMD_START | CMD_RECOVER | CMD_PRINT | CMD_SHUTDOWN)
+    u32 cmd          (CMD_START | CMD_RECOVER | CMD_PRINT | CMD_SHUTDOWN
+                      | CMD_METRICS)
     i32 prev_rank    (-1 if never assigned; stable re-admission key is task_id)
     str task_id
     if start/recover: u32 listen_port   (worker binds BEFORE contacting tracker)
     if print:         str message
+    if metrics:       str json_snapshot (rabit_tpu.obs.ship envelope; the
+                      tracker folds it into the job-level telemetry.json)
 
 tracker -> worker (start/recover reply, sent when the wave of world_size
 workers is complete):
@@ -50,6 +53,7 @@ CMD_START = 1
 CMD_RECOVER = 2
 CMD_PRINT = 3
 CMD_SHUTDOWN = 4
+CMD_METRICS = 5
 
 _U32 = struct.Struct("<I")
 _I32 = struct.Struct("<i")
@@ -161,6 +165,6 @@ def send_hello(
     out = [put_u32(MAGIC_HELLO), put_u32(cmd), put_i32(prev_rank), put_str(task_id)]
     if cmd in (CMD_START, CMD_RECOVER):
         out.append(put_u32(listen_port))
-    elif cmd == CMD_PRINT:
+    elif cmd in (CMD_PRINT, CMD_METRICS):
         out.append(put_str(message))
     send_all(sock, b"".join(out))

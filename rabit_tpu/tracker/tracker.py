@@ -10,16 +10,29 @@ worker death cascades into every survivor reconnecting with ``recover``
 while the launcher restarts the dead one with ``start``; once world_size
 check-ins are pending, the tracker broadcasts a fresh assignment with a
 bumped epoch.
+
+The tracker is also the job-level telemetry aggregator
+(doc/observability.md): it keeps a structured event timeline (bootstrap/
+recovery waves; the robust engine's ``recover_stats``/``failure_detected``
+prints converted to events at ingest), accepts ``CMD_METRICS`` snapshots
+from workers, and writes ``telemetry.json`` into ``RABIT_OBS_DIR`` when
+the job ends.
 """
 
 from __future__ import annotations
 
+import json
 import os
 import socket
 import threading
+import time
 from dataclasses import dataclass
 
+from rabit_tpu.obs.events import event_from_stats_line
 from rabit_tpu.tracker import protocol as P
+
+#: telemetry.json envelope version (bump on incompatible change).
+TELEMETRY_SCHEMA = 1
 
 
 @dataclass
@@ -29,6 +42,7 @@ class _Pending:
     listen_port: int
     host: str
     prev_rank: int
+    cmd: int = P.CMD_START
 
 
 def assign_ranks(
@@ -110,9 +124,23 @@ def tpu_slice_host_order() -> list[str] | None:
 class Tracker:
     def __init__(self, world_size: int, host: str = "127.0.0.1", port: int = 0,
                  quiet: bool = False, topology: str = "auto",
-                 host_order: list[str] | None = None):
+                 host_order: list[str] | None = None,
+                 obs_dir: str | None = None):
         self.world_size = world_size
         self.quiet = quiet
+        # Job-level telemetry (doc/observability.md): structured events
+        # (bootstrap/recovery waves, recover_stats converted from prints),
+        # the latest metric snapshot per rank (CMD_METRICS), restart
+        # counts — written to <obs_dir>/telemetry.json when the job ends.
+        if obs_dir is None:
+            obs_dir = os.environ.get("RABIT_OBS_DIR", "") or None
+        self.obs_dir = obs_dir
+        self.events: list[dict] = []
+        self.snapshots: dict[int, dict] = {}  # rank -> latest shipped snapshot
+        self.telemetry: dict | None = None
+        self._started_at = time.time()
+        self._n_starts: dict[str, int] = {}  # task_id -> CMD_START check-ins
+        self._telemetry_written = False
         # topology: "auto" uses TPU slice metadata when present, "tpu"
         # requires it, anything else is plain host grouping.
         if host_order is None and topology in ("auto", "tpu"):
@@ -152,6 +180,10 @@ class Tracker:
             self._srv.close()
         except OSError:
             pass
+        # Safety net for jobs torn down without a full shutdown wave (kill,
+        # timeout): idempotent, so the normal all-ranks-shut-down path has
+        # already written by the time stop() runs.
+        self.write_telemetry()
 
     # -- serving -----------------------------------------------------------
 
@@ -176,21 +208,41 @@ class Tracker:
             task_id = P.get_str(conn)
             if cmd in (P.CMD_START, P.CMD_RECOVER):
                 listen_port = P.get_u32(conn)
-                self._register(conn, addr[0], task_id, listen_port, prev_rank)
+                self._register(conn, addr[0], task_id, listen_port, prev_rank,
+                               cmd)
                 # conn is answered (and closed) by the wave completer.
                 return
             if cmd == P.CMD_PRINT:
                 msg = P.get_str(conn)
                 self.messages.append(msg)
+                # Legacy-line bridge: the robust engine's recover_stats /
+                # failure_detected prints become structured events here, so
+                # consumers read self.events / telemetry.json instead of
+                # scraping stdout.
+                ev = event_from_stats_line(msg)
+                if ev is not None:
+                    with self._lock:
+                        self.events.append(
+                            {"ts": round(ev.ts, 6), "kind": ev.kind,
+                             **ev.fields})
                 if not self.quiet:
                     print(msg, end="" if msg.endswith("\n") else "\n", flush=True)
                 conn.sendall(P.put_u32(P.ACK))
+            elif cmd == P.CMD_METRICS:
+                msg = P.get_str(conn)
+                self._accept_snapshot(msg)
+                conn.sendall(P.put_u32(P.ACK))
             elif cmd == P.CMD_SHUTDOWN:
                 conn.sendall(P.put_u32(P.ACK))
+                done = False
                 with self._lock:
                     self._n_shutdown += 1
-                    if self._n_shutdown >= self.world_size:
-                        self._done.set()
+                    done = self._n_shutdown >= self.world_size
+                if done:
+                    # Persist BEFORE releasing wait()ers: by the time the
+                    # launcher sees the job done, telemetry.json exists.
+                    self.write_telemetry()
+                    self._done.set()
             conn.close()
         except (ConnectionError, OSError, ValueError):
             try:
@@ -198,7 +250,8 @@ class Tracker:
             except OSError:
                 pass
 
-    def _register(self, conn, host, task_id, listen_port, prev_rank) -> None:
+    def _register(self, conn, host, task_id, listen_port, prev_rank,
+                  cmd=P.CMD_START) -> None:
         with self._lock:
             # A re-check-in from the same task id replaces its stale entry
             # (e.g. worker retried while the wave was still filling).
@@ -208,13 +261,76 @@ class Tracker:
                 except OSError:
                     pass
             self._pending = [p for p in self._pending if p.task_id != task_id]
-            self._pending.append(_Pending(conn, task_id, listen_port, host, prev_rank))
+            self._pending.append(
+                _Pending(conn, task_id, listen_port, host, prev_rank, cmd))
             if len(self._pending) < self.world_size:
                 return
             wave, self._pending = self._pending, []
             epoch = self._epoch
             self._epoch += 1
         self._assign_and_send(wave, epoch)
+
+    # -- telemetry ---------------------------------------------------------
+
+    def _accept_snapshot(self, payload: str) -> None:
+        """Fold one CMD_METRICS JSON envelope into the per-rank table
+        (latest per rank wins — a restarted life's final snapshot replaces
+        its dead predecessor's heartbeat)."""
+        try:
+            snap = json.loads(payload)
+            rank = int(snap.get("rank", -1))
+        except (ValueError, TypeError):
+            return  # malformed snapshot must not hurt the tracker
+        with self._lock:
+            self.snapshots[rank] = snap
+            self.events.append({
+                "ts": round(time.time(), 6), "kind": "metrics_snapshot",
+                "rank": rank, "task_id": snap.get("task_id", ""),
+            })
+
+    def build_telemetry(self) -> dict:
+        """Assemble the job-level telemetry document: per-rank op latency
+        stats/percentiles (from shipped registry snapshots), the
+        bootstrap/recovery wave timeline, and restart counts."""
+        with self._lock:
+            events = list(self.events)
+            snapshots = {str(r): s for r, s in sorted(self.snapshots.items())}
+            restarts = {t: n - 1 for t, n in self._n_starts.items() if n > 1}
+        waves = [e for e in events if e["kind"] == "wave"]
+        return {
+            "schema": TELEMETRY_SCHEMA,
+            "world_size": self.world_size,
+            "started_at": round(self._started_at, 6),
+            "finished_at": round(time.time(), 6),
+            "n_waves": len(waves),
+            "n_recovery_waves": sum(1 for w in waves if w["epoch"] > 0),
+            "restarts": restarts,
+            "waves": waves,
+            "events": events,
+            "ranks": snapshots,
+        }
+
+    def write_telemetry(self) -> str | None:
+        """Write telemetry.json into the obs dir (atomic rename so a
+        concurrent reader never sees a torn file).  Idempotent: the first
+        caller wins; returns the path, or None when no obs dir is set."""
+        with self._lock:
+            if self._telemetry_written:
+                return None
+            self._telemetry_written = True
+        self.telemetry = self.build_telemetry()
+        if not self.obs_dir:
+            return None
+        try:
+            os.makedirs(self.obs_dir, exist_ok=True)
+            path = os.path.join(self.obs_dir, "telemetry.json")
+            tmp = f"{path}.tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump(self.telemetry, f, indent=1, sort_keys=True)
+            os.replace(tmp, path)
+            return path
+        except OSError:
+            return None  # observability must not fail the job
 
     def _assign_and_send(self, wave: list[_Pending], epoch: int) -> None:
         # Stable re-admission > launcher numbering > host-grouped fill; see
@@ -230,6 +346,28 @@ class Tracker:
         peers = {
             self._ranks[p.task_id]: (p.host, p.listen_port) for p in wave
         }
+        # Timeline entry per bootstrap wave.  epoch 0 is the initial wave;
+        # any later wave is a recovery wave: survivors re-check-in with
+        # CMD_RECOVER while the launcher's restarted workers arrive with a
+        # fresh CMD_START — those restarts are the per-task restart count.
+        with self._lock:
+            restarted = []
+            for p in wave:
+                if p.cmd == P.CMD_START:
+                    n_seen = self._n_starts.get(p.task_id, 0)
+                    self._n_starts[p.task_id] = n_seen + 1
+                    if n_seen > 0:
+                        restarted.append(p.task_id)
+            self.events.append({
+                "ts": round(time.time(), 6),
+                "kind": "wave",
+                "epoch": epoch,
+                "assignments": {p.task_id: self._ranks[p.task_id]
+                                for p in wave},
+                "recovering": sorted(p.task_id for p in wave
+                                     if p.cmd == P.CMD_RECOVER),
+                "restarted": sorted(restarted),
+            })
         n = self.world_size
         for p in wave:
             rank = self._ranks[p.task_id]

@@ -4,7 +4,10 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-python -m compileall -q rabit_tpu tests guide tools bench.py __graft_entry__.py
+# rabit_tpu covers its subpackages (engine/, tracker/, parallel/, models/,
+# ops/, obs/); the explicit obs/ entry guards against the package being
+# moved out of the tree without its checks following.
+python -m compileall -q rabit_tpu rabit_tpu/obs tests guide tools bench.py __graft_entry__.py
 make -C native clean > /dev/null
 make -C native CXXFLAGS="-O2 -std=c++17 -fPIC -Wall -Wextra -Wno-unused-parameter -Werror" > /dev/null
 echo "lint OK"

@@ -2,8 +2,29 @@
 # Full test gate (the reference's scripts/travis_script.sh + travis_runtest.sh
 # role): native build + unit tests, Python suite (includes the kill-and-recover
 # scenario matrix under the local tracker), and guide smoke tests.
+#
+# RABIT_OBS_DIR (doc/observability.md) points every spawned worker and
+# tracker at a temp dir; a rank that wedges anywhere in the suite dumps its
+# flight recorder there, and the gate fails LOUDLY on any such hang report —
+# a stuck collective becomes an artifact, not a silent timeout.  (Tests that
+# deliberately induce hangs redirect their workers to private dirs, so a
+# clean suite leaves this dir free of flight-*.jsonl.)
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
+RABIT_OBS_DIR="$(mktemp -d "${TMPDIR:-/tmp}/rabit-obs.XXXXXX")"
+export RABIT_OBS_DIR
+trap 'rm -rf "$RABIT_OBS_DIR"' EXIT
+
 make -C native test
 python -m pytest tests/ -q "$@"
+
+hang_dumps=$(find "$RABIT_OBS_DIR" -name 'flight-*.jsonl' 2>/dev/null || true)
+if [ -n "$hang_dumps" ]; then
+    echo "FATAL: flight-recorder hang dumps were written during the suite:" >&2
+    echo "$hang_dumps" >&2
+    echo "--- first dump header ---" >&2
+    head -n 1 $hang_dumps | sed 's/^/    /' >&2
+    exit 1
+fi
+echo "obs gate OK (no hang dumps in $RABIT_OBS_DIR)"

@@ -1,0 +1,267 @@
+"""Integration tests for job-level telemetry and hang-dump evidence
+(ISSUE 1 acceptance): a mock fault-injected multi-worker run must produce a
+tracker ``telemetry.json`` with per-rank allreduce latency stats and a
+recovery-wave timeline, and an induced hang must leave per-rank
+flight-recorder dumps in ``RABIT_OBS_DIR``."""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+from rabit_tpu.tracker.launcher import LocalCluster, cpu_worker_env
+
+REPO = Path(__file__).resolve().parents[1]
+WORKER = str(REPO / "tests" / "workers" / "recover_worker.py")
+
+
+def run_obs_cluster(tmp_path, worker_args, world=4, max_restarts=5,
+                    timeout=120.0):
+    """A LocalCluster run with RABIT_OBS_DIR pointed at a private dir for
+    BOTH sides: the workers (flight dumps, obs config) via the child env,
+    and the tracker (telemetry.json) via an explicit env override around
+    its construction."""
+    obs_dir = tmp_path / "obs"
+    env = cpu_worker_env()
+    env["RABIT_OBS_DIR"] = str(obs_dir)
+    cluster = LocalCluster(world, max_restarts=max_restarts, quiet=True,
+                           extra_env=env)
+    cmd = [sys.executable, WORKER, "rabit_engine=mock", *worker_args]
+    old = os.environ.get("RABIT_OBS_DIR")
+    os.environ["RABIT_OBS_DIR"] = str(obs_dir)
+    try:
+        rc = cluster.run(cmd, timeout=timeout)
+    finally:
+        if old is None:
+            os.environ.pop("RABIT_OBS_DIR", None)
+        else:
+            os.environ["RABIT_OBS_DIR"] = old
+    assert rc == 0
+    assert all(r == 0 for r in cluster.returncodes)
+    return cluster, obs_dir
+
+
+def test_telemetry_json_records_recovery_wave(tmp_path):
+    """The acceptance scenario: rank 1 is mock-killed mid-iteration; the
+    tracker's telemetry.json must show the recovery wave, the restart
+    count, and per-rank allreduce latency stats with percentiles."""
+    cluster, obs_dir = run_obs_cluster(
+        tmp_path,
+        ["ndata=1000", "niter=3", "mock=1,1,1,0", "rabit_recover_stats=1"],
+    )
+    assert cluster.restarts[1] == 1
+    path = obs_dir / "telemetry.json"
+    assert path.exists(), f"no telemetry.json in {list(obs_dir.iterdir())}"
+    t = json.loads(path.read_text())
+
+    # recovery-wave timeline: initial wave (epoch 0) + one recovery wave
+    # in which task 1 restarted while the survivors re-checked in
+    assert t["world_size"] == 4
+    assert t["n_waves"] >= 2
+    assert t["n_recovery_waves"] >= 1
+    recovery = [w for w in t["waves"] if w["epoch"] > 0]
+    assert any("1" in w["restarted"] for w in recovery), t["waves"]
+    assert any(len(w["recovering"]) == 3 for w in recovery), t["waves"]
+    assert t["restarts"] == {"1": 1}
+
+    # per-rank allreduce latency stats: every rank shipped a snapshot with
+    # call counts and histogram percentiles
+    assert set(t["ranks"]) == {"0", "1", "2", "3"}
+    for rank, snap in t["ranks"].items():
+        ops = snap["metrics"]["ops"]
+        assert ops["allreduce"]["calls"] >= 1, (rank, ops)
+        hist = snap["metrics"]["histograms"]["allreduce_latency_seconds"]
+        assert hist["count"] >= 1
+        assert 0 < hist["p50"] <= hist["p99"] <= hist["max"]
+
+    # the robust engine's recover_stats/failure_detected prints arrived as
+    # structured events, not just console lines
+    kinds = {e["kind"] for e in t["events"]}
+    assert "failure_detected" in kinds
+    assert any(e["kind"] == "recover_stats" and e.get("version", 0) > 0
+               for e in t["events"])
+    # same data is live on the cluster object for tools/ consumers
+    assert cluster.telemetry is not None
+    assert cluster.events and any(e["kind"] == "wave" for e in cluster.events)
+
+
+def test_telemetry_json_clean_run(tmp_path):
+    """No faults: telemetry still aggregates all ranks, with exactly the
+    initial bootstrap wave and zero restarts."""
+    cluster, obs_dir = run_obs_cluster(
+        tmp_path, ["ndata=100", "niter=2"], world=3, max_restarts=0)
+    t = json.loads((obs_dir / "telemetry.json").read_text())
+    assert t["n_recovery_waves"] == 0
+    assert t["restarts"] == {}
+    assert set(t["ranks"]) == {"0", "1", "2"}
+    # a clean run must leave NO flight-recorder dumps behind
+    dumps = list(obs_dir.glob("flight-*.jsonl"))
+    assert dumps == [], dumps
+
+
+def test_cmd_metrics_wire_and_heartbeat(tmp_path):
+    """CMD_METRICS snapshots land in the tracker's per-rank table — via a
+    direct ship and via the Heartbeat thread (latest snapshot wins)."""
+    import time as _time
+
+    from rabit_tpu.obs.metrics import MetricsRegistry
+    from rabit_tpu.obs.ship import Heartbeat, build_snapshot, ship_snapshot
+    from rabit_tpu.tracker.tracker import Tracker
+
+    tracker = Tracker(world_size=1, quiet=True,
+                      obs_dir=str(tmp_path / "obs")).start()
+    try:
+        reg = MetricsRegistry()
+        reg.observe_op("allreduce", 64, 0.001)
+        assert ship_snapshot(build_snapshot(reg, 0, "0"), tracker.host,
+                             tracker.port, "0")
+        deadline = _time.time() + 5
+        while _time.time() < deadline and 0 not in tracker.snapshots:
+            _time.sleep(0.02)
+        assert tracker.snapshots[0]["metrics"]["ops"]["allreduce"]["calls"] == 1
+
+        reg.observe_op("allreduce", 64, 0.002)
+        hb = Heartbeat(0.05, lambda: build_snapshot(reg, 0, "0"),
+                       tracker.host, tracker.port, "0").start()
+        deadline = _time.time() + 5
+        while (_time.time() < deadline and
+               tracker.snapshots[0]["metrics"]["ops"]["allreduce"]["calls"] < 2):
+            _time.sleep(0.02)
+        hb.stop()
+        assert tracker.snapshots[0]["metrics"]["ops"]["allreduce"]["calls"] == 2
+    finally:
+        tracker.stop()
+    # stop() on a never-completed job still flushes telemetry with what it has
+    t = json.loads((tmp_path / "obs" / "telemetry.json").read_text())
+    assert t["ranks"]["0"]["metrics"]["ops"]["allreduce"]["calls"] == 2
+
+
+# -- hang dump ---------------------------------------------------------------
+
+HANG_WORKER_SRC = """
+import os, sys, time
+import numpy as np
+import rabit_tpu as rt
+
+rt.init()
+rank, world = rt.get_rank(), rt.get_world_size()
+with open(os.environ["HANG_READY_DIR"] + f"/ready.{rank}", "w") as f:
+    f.write("1")
+for it in range(100):
+    rt.allreduce(np.full(16, float(rank + it), np.float64), rt.SUM)
+    time.sleep(0.05)
+rt.finalize()
+"""
+
+
+def test_hang_dumps_flight_recorder(tmp_path):
+    """A SIGSTOPped peer wedges the survivors inside a collective; each
+    survivor's obs watchdog (rabit_obs_hang_sec) must dump its flight
+    recorder to RABIT_OBS_DIR so the hang leaves evidence."""
+    from rabit_tpu.tracker.tracker import Tracker
+
+    obs_dir = tmp_path / "obs"
+    ready = tmp_path / "ready"
+    ready.mkdir()
+    worker = tmp_path / "worker.py"
+    worker.write_text(HANG_WORKER_SRC)
+    world = 3
+    tracker = Tracker(world_size=world, quiet=True).start()
+    procs = []
+    for i in range(world):
+        env = dict(os.environ)
+        env.update(
+            PYTHONPATH=f"{REPO}:{env.get('PYTHONPATH', '')}",
+            DMLC_TRACKER_URI=tracker.host,
+            DMLC_TRACKER_PORT=str(tracker.port),
+            DMLC_TASK_ID=str(i),
+            HANG_READY_DIR=str(ready),
+            RABIT_OBS_DIR=str(obs_dir),
+        )
+        procs.append(subprocess.Popen(
+            [sys.executable, str(worker), "rabit_engine=native",
+             "rabit_obs_hang_sec=1",
+             # keep the native engine's own detectors out of the window so
+             # the obs watchdog is what fires
+             "rabit_timeout_sec=120"],
+            env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        ))
+    try:
+        deadline = time.time() + 60
+        while time.time() < deadline and len(list(ready.iterdir())) < world:
+            time.sleep(0.05)
+        assert len(list(ready.iterdir())) == world, "workers did not init"
+        time.sleep(0.3)  # into the iteration loop
+        os.kill(procs[1].pid, signal.SIGSTOP)
+        # survivors block in allreduce; the 1s obs watchdog must dump
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            dumps = list(obs_dir.glob("flight-*-hang.jsonl")) if obs_dir.exists() else []
+            if len(dumps) >= 2:
+                break
+            time.sleep(0.2)
+        os.kill(procs[1].pid, signal.SIGCONT)
+        dumps = sorted(obs_dir.glob("flight-*-hang.jsonl"))
+        assert len(dumps) >= 2, f"expected survivor dumps, got {dumps}"
+        from rabit_tpu.obs.events import load_dump
+
+        events = load_dump(dumps[0])
+        header = events[0]
+        assert header.kind == "flight_dump"
+        assert header.fields["reason"] == "hang"
+        kinds = [e.kind for e in events]
+        assert "hang_detected" in kinds
+        assert "op_inflight" in kinds  # the stuck collective is identified
+        stuck = next(e for e in events if e.kind == "op_inflight")
+        assert stuck.fields["op"] == "allreduce"
+        assert stuck.fields["stuck_seconds"] >= 1.0
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+        tracker.stop()
+
+
+def test_sigterm_dumps_flight_recorder(tmp_path):
+    """SIGTERM on a worker with RABIT_OBS_DIR set dumps the ring before the
+    process dies with the normal SIGTERM status."""
+    obs_dir = tmp_path / "obs"
+    src = (
+        "import os, signal, sys, time\n"
+        "import numpy as np\n"
+        "import rabit_tpu as rt\n"
+        "rt.init()\n"
+        "rt.allreduce(np.arange(4, dtype=np.float32), rt.SUM)\n"
+        "print('READY', flush=True)\n"
+        "time.sleep(30)\n"
+    )
+    worker = tmp_path / "solo.py"
+    worker.write_text(src)
+    env = dict(os.environ)
+    env.update(PYTHONPATH=f"{REPO}:{env.get('PYTHONPATH', '')}",
+               RABIT_OBS_DIR=str(obs_dir))
+    proc = subprocess.Popen([sys.executable, str(worker)], env=env,
+                            stdout=subprocess.PIPE, text=True)
+    try:
+        assert proc.stdout.readline().strip() == "READY"
+        proc.send_signal(signal.SIGTERM)
+        proc.wait(timeout=15)
+        assert proc.returncode == -signal.SIGTERM
+        dumps = list(obs_dir.glob("flight-*-sigterm.jsonl"))
+        assert len(dumps) == 1, list(obs_dir.iterdir())
+        from rabit_tpu.obs.events import load_dump
+
+        events = load_dump(dumps[0])
+        assert events[0].fields["reason"] == "sigterm"
+        assert any(e.kind == "op_end" and e.fields["op"] == "allreduce"
+                   for e in events)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()

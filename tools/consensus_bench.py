@@ -64,24 +64,24 @@ def run_mode(world: int, iters: int, summary_on: bool) -> tuple[float, dict]:
         ]
         rc = cluster.run(cmd, timeout=1200.0)
         assert rc == 0, f"cluster failed rc={rc}"
-        # Protocol-structure counters from rank 0's shutdown line: per-op
+        # Protocol-structure counters from rank 0's shutdown-time
+        # recover_stats_final, delivered as a structured tracker event
+        # (cluster.events — the tracker converts the print at ingest; the
+        # old parse_stats_line scraping is deprecated): per-op
         # critical-path depth, the scheduling-independent O(log W) vs O(W)
         # exhibit (wall clocks at oversubscribed worlds measure the
         # scheduler, these measure the protocol).
-        from rabit_tpu.profile import parse_stats_line
-
         stats: dict = {}
-        for m in cluster.messages:
-            if "recover_stats_final" in m and m.startswith("[0]"):
-                kv = parse_stats_line(m)
-                sr = int(kv.get("summary_rounds", 0))
-                tr = int(kv.get("table_rounds", 0))
+        for ev in cluster.events:
+            if ev["kind"] == "recover_stats_final" and ev.get("rank") == 0:
+                sr = ev.get("summary_rounds", 0)
+                tr = ev.get("table_rounds", 0)
                 if sr:
                     stats["depth_per_summary"] = round(
-                        int(kv["summary_depth"]) / sr, 2)
+                        ev["summary_depth"] / sr, 2)
                 if tr:
                     stats["hops_per_table"] = round(
-                        int(kv["table_hops"]) / tr, 2)
+                        ev["table_hops"] / tr, 2)
                 break
         return float(out.read_text()), stats
 

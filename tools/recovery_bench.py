@@ -34,7 +34,6 @@ from pathlib import Path
 REPO = Path(__file__).resolve().parents[1]
 sys.path.insert(0, str(REPO))
 
-from rabit_tpu.profile import is_recovery_stats_line, parse_stats_line  # noqa: E402
 from rabit_tpu.tracker.launcher import LocalCluster, cpu_worker_env  # noqa: E402
 
 WORKER = str(REPO / "tests" / "workers" / "recover_worker.py")
@@ -83,31 +82,31 @@ def run_once(world: int, extra: list[str], timeout: float | None = None,
         latency = min(stamps) - cluster.death_times[0]
     # Kill -> first survivor notices (EOF cascade / stall timeout), the
     # latency role the reference's unused OOB urgent-byte path targeted.
+    # Structured events (cluster.events): the tracker converts the robust
+    # engine's failure_detected / recover_stats prints into typed events —
+    # no stdout scraping (the old parse_stats_line path is deprecated,
+    # see rabit_tpu/profile.py).
     detect = None
-    detects = [
-        float(m.split("at=")[1].split()[0])
-        for m in cluster.messages
-        if "failure_detected" in m
-    ]
+    detects = [ev["at"] for ev in cluster.events
+               if ev["kind"] == "failure_detected" and "at" in ev]
     if detects and cluster.death_times:
         detect = min(detects) - cluster.death_times[0]
     # Protocol-event counters from the restarted worker's LoadCheckPoint
     # (rabit_recover_stats=1): version>0 identifies the recovered life —
-    # first lives print version=0.  Scheduling-independent, unlike wall
+    # first lives report version=0.  Scheduling-independent, unlike wall
     # time at oversubscribed world sizes.
     events = None
-    for m in cluster.messages:
-        if not is_recovery_stats_line(m):
+    for ev in cluster.events:
+        if ev["kind"] != "recover_stats" or ev.get("version", 0) <= 0:
             continue
-        fields = parse_stats_line(m)
         events = {
-            "summary_rounds": int(fields["summary_rounds"]),
-            "table_rounds": int(fields["table_rounds"]),
-            "serve_bytes": int(fields["serve_bytes"]),
+            "summary_rounds": ev["summary_rounds"],
+            "table_rounds": ev["table_rounds"],
+            "serve_bytes": ev["serve_bytes"],
         }
-        if "summary_depth" in fields:  # measured critical-path structure
-            events["summary_depth"] = int(fields["summary_depth"])
-            events["table_hops"] = int(fields["table_hops"])
+        if "summary_depth" in ev:  # measured critical-path structure
+            events["summary_depth"] = ev["summary_depth"]
+            events["table_hops"] = ev["table_hops"]
         break
     return dt, latency, events, detect, resume_latency
 
