@@ -1,0 +1,394 @@
+"""Chaos proxy — scriptable TCP fault injection for liveness testing.
+
+A :class:`ChaosProxy` sits between workers and the tracker (or between
+peers) and forwards byte streams while injecting the network-fault shapes
+that dominate real TPU-pod incidents (PAPERS.md: "Highly Available Data
+Parallel ML training on Mesh Networks", "Don't Let a Few Network Failures
+Slow the Entire AllReduce"):
+
+* **refuse** — a new connection is accepted and immediately closed
+  (flaky dial path; exercises connect retry/backoff);
+* **delay** — every forwarded chunk waits a sampled latency first
+  (congested DCN; exercises timeout margins);
+* **truncate** — the client→upstream stream is severed after a sampled
+  prefix, mid-message (torn hello; exercises the tracker's per-connection
+  read deadline and the client's retry);
+* **blackhole** — the connection stays open but nothing is ever forwarded
+  (silent partition; the worst shape — only deadlines catch it);
+* **partition** — a switch: while on, new connections are refused and
+  every established one is severed.
+
+All randomness comes from one seeded ``random.Random`` so a failing fuzz
+schedule replays exactly.  The proxy is pure stdlib and threads; a
+connection costs two pump threads, which is plenty for protocol-level
+fuzzing (the tracker wire is one short exchange per message).
+
+:func:`run_schedule` is the shared fuzz harness (tests/test_chaos.py and
+tools/chaos_bench.py): it drives full bootstrap + recovery waves of
+thread-workers through the proxy against a real in-process tracker, heals
+the network, and requires the job to converge — completion or fail-fast,
+never a hang.
+"""
+
+from __future__ import annotations
+
+import random
+import socket
+import threading
+import time
+from dataclasses import dataclass, field
+
+from rabit_tpu.tracker import protocol as P
+from rabit_tpu.tracker.tracker import Tracker
+
+#: recv chunk size of the pump loops; also the granularity of delay faults.
+_CHUNK = 4096
+
+
+@dataclass
+class FaultSpec:
+    """Probabilities/ranges of the injected faults.  Mutable at runtime:
+    assigning a fresh spec to ``proxy.spec`` re-scripts the proxy live
+    (e.g. heavy faults during bootstrap, then heal)."""
+
+    p_refuse: float = 0.0
+    p_truncate: float = 0.0
+    truncate_bytes: tuple[int, int] = (0, 64)
+    p_blackhole: float = 0.0
+    delay: tuple[float, float] = (0.0, 0.0)
+
+    def clear(self) -> "FaultSpec":
+        return FaultSpec()
+
+
+@dataclass
+class ChaosStats:
+    connections: int = 0
+    refused: int = 0
+    truncated: int = 0
+    blackholed: int = 0
+    severed_by_partition: int = 0
+    bytes_forwarded: int = 0
+
+
+@dataclass
+class _Conn:
+    client: socket.socket
+    upstream: socket.socket
+    closed: bool = False
+    lock: threading.Lock = field(default_factory=threading.Lock)
+
+    def sever(self) -> None:
+        with self.lock:
+            if self.closed:
+                return
+            self.closed = True
+        for s in (self.client, self.upstream):
+            try:
+                s.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                s.close()
+            except OSError:
+                pass
+
+
+class ChaosProxy:
+    """TCP proxy with scriptable fault injection (see module docstring).
+
+    Usage::
+
+        proxy = ChaosProxy((tracker.host, tracker.port),
+                           FaultSpec(p_refuse=0.3), seed=7).start()
+        ...point workers at (proxy.host, proxy.port)...
+        proxy.spec = FaultSpec()        # heal mid-run
+        proxy.set_partition(True)       # or cut everything
+        proxy.stop()
+    """
+
+    def __init__(self, upstream: tuple[str, int],
+                 spec: FaultSpec | None = None, seed: int = 0,
+                 listen_host: str = "127.0.0.1", listen_port: int = 0):
+        self.upstream = (upstream[0], int(upstream[1]))
+        self.spec = spec if spec is not None else FaultSpec()
+        self.stats = ChaosStats()
+        self._rng = random.Random(seed)
+        self._rng_lock = threading.Lock()
+        self._partitioned = False
+        self._stopped = threading.Event()
+        self._conns: list[_Conn] = []
+        self._conns_lock = threading.Lock()
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind((listen_host, listen_port))
+        self._srv.listen(128)
+        self.host, self.port = self._srv.getsockname()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "ChaosProxy":
+        threading.Thread(target=self._accept_loop, daemon=True,
+                         name="chaos-accept").start()
+        return self
+
+    def stop(self) -> None:
+        self._stopped.set()
+        try:
+            self._srv.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+        with self._conns_lock:
+            conns, self._conns = self._conns, []
+        for c in conns:
+            c.sever()
+
+    def set_partition(self, on: bool) -> None:
+        """While partitioned, refuse new connections and sever live ones."""
+        self._partitioned = bool(on)
+        if on:
+            with self._conns_lock:
+                conns, self._conns = self._conns, []
+            for c in conns:
+                self.stats.severed_by_partition += 1
+                c.sever()
+
+    # -- internals ---------------------------------------------------------
+
+    def _roll(self) -> random.Random:
+        # One shared seeded stream; per-decision access is serialized so a
+        # given seed yields a reproducible fault sequence for a (mostly)
+        # deterministic connection order.
+        return self._rng
+
+    def _accept_loop(self) -> None:
+        while not self._stopped.is_set():
+            try:
+                client, _ = self._srv.accept()
+            except OSError:
+                return
+            self.stats.connections += 1
+            with self._rng_lock:
+                refuse = (self._partitioned or
+                          self._roll().random() < self.spec.p_refuse)
+            if refuse:
+                self.stats.refused += 1
+                try:
+                    client.close()
+                except OSError:
+                    pass
+                continue
+            threading.Thread(target=self._serve_conn, args=(client,),
+                             daemon=True, name="chaos-conn").start()
+
+    def _serve_conn(self, client: socket.socket) -> None:
+        try:
+            up = socket.create_connection(self.upstream, timeout=5.0)
+        except OSError:
+            try:
+                client.close()
+            except OSError:
+                pass
+            return
+        conn = _Conn(client, up)
+        with self._conns_lock:
+            self._conns.append(conn)
+        spec = self.spec
+        with self._rng_lock:
+            rng = self._roll()
+            blackhole = rng.random() < spec.p_blackhole
+            truncate_at = None
+            if rng.random() < spec.p_truncate:
+                truncate_at = rng.randint(*spec.truncate_bytes)
+            delays = spec.delay
+        if blackhole:
+            # Forward nothing, close nothing: the silent-partition shape.
+            # The conn stays registered so stop()/partition() reap it, and
+            # both endpoints see only their own deadlines.
+            self.stats.blackholed += 1
+            return
+        if truncate_at is not None:
+            self.stats.truncated += 1
+        threading.Thread(
+            target=self._pump, args=(conn, client, up, truncate_at, delays),
+            daemon=True, name="chaos-pump-c2u").start()
+        threading.Thread(
+            target=self._pump, args=(conn, up, client, None, delays),
+            daemon=True, name="chaos-pump-u2c").start()
+
+    def _pump(self, conn: _Conn, src: socket.socket, dst: socket.socket,
+              truncate_at: int | None, delays: tuple[float, float]) -> None:
+        budget = truncate_at
+        try:
+            try:
+                src.settimeout(0.2)  # poll the stop/partition flags
+            except OSError:
+                return  # the sibling pump already severed this conn
+            while not self._stopped.is_set() and not conn.closed:
+                try:
+                    data = src.recv(_CHUNK)
+                except socket.timeout:
+                    continue
+                except OSError:
+                    break
+                if not data:
+                    break
+                if delays[1] > 0:
+                    with self._rng_lock:
+                        pause = self._roll().uniform(*delays)
+                    if pause > 0:
+                        time.sleep(pause)
+                if budget is not None:
+                    data = data[:budget]
+                    budget -= len(data)
+                try:
+                    if data:
+                        dst.sendall(data)
+                        self.stats.bytes_forwarded += len(data)
+                except OSError:
+                    break
+                if budget == 0:
+                    break  # prefix forwarded; sever mid-message
+        finally:
+            conn.sever()
+
+
+# -- fuzz schedule runner ----------------------------------------------------
+
+@dataclass
+class ScheduleResult:
+    seed: int
+    world: int
+    rounds: int
+    completed: bool
+    epoch: int
+    rank_of: dict[str, int]
+    elapsed: float
+    stats: ChaosStats
+    outcome: str  # "completed" | "failed_fast"
+
+
+def _random_spec(rng: random.Random) -> FaultSpec:
+    """A sampled fault mix: always at least one fault family active."""
+    spec = FaultSpec(
+        p_refuse=rng.choice([0.0, 0.2, 0.5]),
+        p_truncate=rng.choice([0.0, 0.2, 0.5]),
+        p_blackhole=rng.choice([0.0, 0.15]),
+        delay=rng.choice([(0.0, 0.0), (0.0, 0.02), (0.01, 0.05)]),
+    )
+    if (spec.p_refuse == spec.p_truncate == spec.p_blackhole == 0.0
+            and spec.delay[1] == 0.0):
+        spec.p_refuse = 0.3
+    return spec
+
+
+def run_schedule(seed: int, world: int | None = None,
+                 faulty_rounds: int = 2, deadline_sec: float = 20.0,
+                 quiet: bool = True) -> ScheduleResult:
+    """One fuzzed bootstrap/recovery scenario (deterministic per seed).
+
+    Thread-workers bootstrap through a freshly scripted :class:`ChaosProxy`
+    against a real :class:`Tracker`, in rounds that mirror the native
+    engine's re-wave loop (comm.cc Init): every worker check-ins and waits
+    for its assignment; a round where anyone failed or the epochs disagree
+    is retried with survivors sending CMD_RECOVER — exactly the protocol's
+    failed-wave contract.  One sampled worker "dies" after its first
+    successful check-in and re-enters as a restart (fresh CMD_START, same
+    task id), fuzzing the stale-entry replacement path.  After
+    ``faulty_rounds`` rounds the proxy is healed, so every schedule must
+    then CONVERGE: all workers agree on one epoch with stable, distinct
+    ranks.  Any outcome is acceptable except a hang — every socket
+    operation is bounded, and the schedule deadline converts "stuck" into a
+    hard failure.
+    """
+    rng = random.Random(seed)
+    world = world if world is not None else rng.choice([2, 3, 4])
+    tracker = Tracker(world, quiet=True, conn_timeout_sec=1.0).start()
+    proxy = ChaosProxy((tracker.host, tracker.port), _random_spec(rng),
+                       seed=seed).start()
+    t0 = time.monotonic()
+    deadline = t0 + deadline_sec
+    tasks = [str(i) for i in range(world)]
+    cmd = {t: P.CMD_START for t in tasks}
+    rank_of: dict[str, int] = {}
+    die_once = rng.choice(tasks) if rng.random() < 0.5 else None
+    rounds = 0
+    completed = False
+    epoch = -1
+    try:
+        while time.monotonic() < deadline:
+            rounds += 1
+            if rounds > faulty_rounds:
+                proxy.spec = FaultSpec()  # heal: convergence now mandatory
+            results: dict[str, object] = {}
+
+            # Every RPC is bounded: retries+1 attempts x (connect timeout +
+            # reply timeout) + backoff.  A thread alive past that sum is a
+            # genuine hang (the watchdog-bound analog of this harness), not
+            # a slow retry.
+            retries, timeout, reply_timeout = 4, 0.25, 0.5
+            worst_thread = (retries + 1) * (timeout + reply_timeout) + 2.0
+
+            def boot(task_id: str) -> None:
+                try:
+                    results[task_id] = P.tracker_rpc(
+                        proxy.host, proxy.port, cmd[task_id], task_id,
+                        prev_rank=rank_of.get(task_id, -1),
+                        listen_port=40000 + int(task_id),
+                        timeout=timeout, reply_timeout=reply_timeout,
+                        retries=retries, backoff=0.02, backoff_cap=0.2,
+                        rng=random.Random(f"{seed}:{task_id}:{rounds}"),
+                    )
+                except P.TrackerUnreachable as exc:
+                    results[task_id] = exc
+
+            threads = [threading.Thread(target=boot, args=(t,), daemon=True)
+                       for t in tasks]
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join(timeout=worst_thread)
+                if th.is_alive():
+                    raise TimeoutError(
+                        f"schedule seed={seed}: worker thread hung past its "
+                        f"RPC bound ({worst_thread:.0f}s, round {rounds})")
+            asgs = {t: r for t, r in results.items()
+                    if isinstance(r, P.Assignment)}
+            for t, asg in asgs.items():
+                prev = rank_of.get(t)
+                if prev is not None and prev != asg.rank:
+                    raise AssertionError(
+                        f"seed={seed}: task {t} rank changed {prev} -> "
+                        f"{asg.rank} (stable re-admission violated)")
+                rank_of[t] = asg.rank
+            if len(asgs) == world:
+                epochs = {a.epoch for a in asgs.values()}
+                ranks = sorted(a.rank for a in asgs.values())
+                if len(epochs) == 1 and ranks == list(range(world)):
+                    epoch = epochs.pop()
+                    completed = True
+                    break
+            # Failed wave: survivors re-enter as recover (the BuildLinks
+            # failure path), failures keep re-sending CMD_START.
+            for t in tasks:
+                cmd[t] = P.CMD_RECOVER if t in asgs else P.CMD_START
+            if die_once is not None and die_once in asgs:
+                cmd[die_once] = P.CMD_START  # its "restart" re-enters fresh
+                die_once = None
+        if not completed and time.monotonic() >= deadline:
+            raise TimeoutError(
+                f"schedule seed={seed}: no convergence within "
+                f"{deadline_sec}s ({rounds} rounds)")
+    finally:
+        proxy.stop()
+        tracker.stop()
+    return ScheduleResult(
+        seed=seed, world=world, rounds=rounds, completed=completed,
+        epoch=epoch, rank_of=dict(rank_of),
+        elapsed=time.monotonic() - t0, stats=proxy.stats,
+        outcome="completed" if completed else "failed_fast",
+    )

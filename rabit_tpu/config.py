@@ -79,6 +79,18 @@ DEFAULTS: dict[str, str] = {
     "rabit_obs_capacity": "2048",
     "rabit_obs_hang_sec": "300",
     "rabit_obs_heartbeat_sec": "0",
+    # Liveness layer (doc/fault_tolerance.md).  rabit_heartbeat_sec > 0:
+    # renew a CMD_HEARTBEAT lease with the tracker every N seconds; the
+    # tracker suspects this worker (lease_expired event + on_suspect
+    # callback, which the launcher wires to SIGKILL-and-restart) after
+    # 2 x N seconds of silence — the failure detector for SILENT deaths
+    # (frozen process, preempted VM) that raise no exit code and no TCP
+    # error.  rabit_hang_abort_sec > 0: a collective stuck in flight this
+    # long makes the rank dump its flight recorder and abort itself
+    # (exit 11, dump-then-die) so the launcher restarts it — the
+    # worker-side belt to the tracker lease's suspenders.
+    "rabit_heartbeat_sec": "0",
+    "rabit_hang_abort_sec": "0",
     # Default ON, matching the native engine (see comm.cc Configure): with
     # Nagle on, every cold-direction header write stalls ~40ms behind the
     # peer's delayed ACK — measured 44ms/op on loopback object broadcasts.

@@ -128,7 +128,25 @@ class NativeEngine(Engine):
         args = [f"{k}={v}".encode() for k, v in cfg.items()]
         arr = (ctypes.c_char_p * len(args))(*args)
         self.obs_event("engine_init", backend=self._kind)
-        self._check(self._lib.RabitInit(len(args), arr), "init")
+        try:
+            self._check(self._lib.RabitInit(len(args), arr), "init")
+        except NativeError as exc:
+            # Fail-fast diagnosis: a dead tracker surfaces from the native
+            # bootstrap as a connect failure after its bounded
+            # rabit_connect_retry backoff loop (socket.cc Connect).  Name
+            # the address and the budget so the operator sees "tracker
+            # gone", not a bare errno.
+            if "connect to" in str(exc):
+                uri = self.config.get("rabit_tracker_uri", "NULL")
+                port = self.config.get("rabit_tracker_port", "9091")
+                retry = self.config.get_int("rabit_connect_retry", 5)
+                raise NativeError(
+                    f"{exc} — tracker at {uri}:{port} unreachable after "
+                    f"{retry + 1} backed-off connect attempts "
+                    f"(rabit_connect_retry={retry}); is the tracker "
+                    f"running?"
+                ) from exc
+            raise
         # (Re)bootstrap complete: the assignment is live.  Restarted lives
         # see DMLC_NUM_ATTEMPT > 0 — the recorder then shows the reconnect
         # wave this rank came back through.

@@ -16,6 +16,16 @@ This module owns the process-wide singletons and the failure paths: when
 collective stuck past ``rabit_obs_hang_sec`` dumps the flight recorder to
 ``<dir>/flight-rank<R>-pid<P>-<reason>.jsonl`` (NCCL-flight-recorder
 style), so hangs produce evidence instead of silence.
+
+Two liveness escalations ride the same watchdog (doc/fault_tolerance.md):
+
+* ``rabit_hang_abort_sec`` > 0 — dump-then-die: after the evidence dump, a
+  rank stuck past the bound aborts itself (exit ``HANG_ABORT_EXIT``) so
+  the launcher restarts it and the job heals instead of idling;
+* ``rabit_heartbeat_sec`` > 0 — a lease renewal ticker to the tracker
+  (``CMD_HEARTBEAT``).  Renewal is withheld once the watchdog declares
+  this process hung, so a stuck-but-scheduling worker is suspected by the
+  tracker exactly like a frozen one.
 """
 
 from __future__ import annotations
@@ -23,6 +33,7 @@ from __future__ import annotations
 import contextlib
 import os
 import signal
+import sys
 import threading
 import time
 
@@ -44,6 +55,11 @@ from rabit_tpu.obs.metrics import (  # noqa: F401 (re-exports)
     _Span,
 )
 from rabit_tpu.obs import ship as _ship
+
+#: Exit code of the hang-abort escalation (dump-then-die).  Distinct from
+#: the native recovery watchdog's exit 10 so launch logs tell the two
+#: detectors apart.
+HANG_ABORT_EXIT = 11
 
 #: Process-wide flight recorder (engine + api layers record into it).
 GLOBAL_RECORDER = FlightRecorder()
@@ -71,13 +87,18 @@ class _ObsState:
         self.lock = threading.Lock()
         self.obs_dir: str = ""
         self.hang_sec: float = 300.0
+        self.hang_abort_sec: float = 0.0
+        self.heartbeat_sec: float = 0.0
         self.rank: int = -1
         self.task_id: str = ""
         self.tracker: tuple[str, int] | None = None
         self.heartbeat: _ship.Heartbeat | None = None
+        self.lease_hb: _ship.Heartbeat | None = None
         self.watchdog_started = False
         self.sigterm_installed = False
         self.prev_sigterm = None
+        # set by the watchdog when it declares this process hung; gates the
+        # one-shot dump AND withholds further lease renewals
         self.hang_dumped = False
         # thread-id -> (op, cache_key, t0_monotonic) of in-flight collectives
         self.inflight: dict[int, tuple[str, str | None, float]] = {}
@@ -91,9 +112,10 @@ def configure(config, rank: int = -1) -> None:
     ``rabit_tpu.init`` after the engine is up (and safe to call again on a
     later init: singletons persist, identity/settings are refreshed).
 
-    Keys (doc/observability.md): ``rabit_obs_dir`` (also the plain
-    ``RABIT_OBS_DIR`` env var), ``rabit_obs_capacity``,
-    ``rabit_obs_hang_sec``, ``rabit_obs_heartbeat_sec``.
+    Keys (doc/observability.md, doc/fault_tolerance.md): ``rabit_obs_dir``
+    (also the plain ``RABIT_OBS_DIR`` env var), ``rabit_obs_capacity``,
+    ``rabit_obs_hang_sec``, ``rabit_obs_heartbeat_sec``,
+    ``rabit_hang_abort_sec``, ``rabit_heartbeat_sec``.
     """
     obs_dir = (config.get("rabit_obs_dir", "") or
                os.environ.get("RABIT_OBS_DIR", "") or "")
@@ -101,7 +123,9 @@ def configure(config, rank: int = -1) -> None:
         obs_dir = ""
     capacity = config.get_int("rabit_obs_capacity", DEFAULT_CAPACITY)
     hang_sec = float(config.get("rabit_obs_hang_sec", "300") or "300")
+    hang_abort_sec = float(config.get("rabit_hang_abort_sec", "0") or "0")
     heartbeat_sec = float(config.get("rabit_obs_heartbeat_sec", "0") or "0")
+    lease_sec = float(config.get("rabit_heartbeat_sec", "0") or "0")
     tracker_uri = config.get("rabit_tracker_uri", "NULL")
     task_id = config.get("rabit_task_id", "NULL") or "NULL"
 
@@ -109,6 +133,8 @@ def configure(config, rank: int = -1) -> None:
     with _STATE.lock:
         _STATE.obs_dir = obs_dir
         _STATE.hang_sec = hang_sec
+        _STATE.hang_abort_sec = hang_abort_sec
+        _STATE.heartbeat_sec = lease_sec
         _STATE.rank = rank
         _STATE.task_id = task_id
         _STATE.tracker = None
@@ -119,16 +145,23 @@ def configure(config, rank: int = -1) -> None:
     if obs_dir:
         os.makedirs(obs_dir, exist_ok=True)
         _install_sigterm_dump()
-        if hang_sec > 0:
-            _start_hang_watchdog()
+    # The watchdog serves three consumers: evidence dumps (needs a dir),
+    # the hang-abort escalation, and hang-gated lease renewal.  Start it
+    # when any of them is live.
+    lease_on = lease_sec > 0 and _STATE.tracker is not None
+    if ((hang_sec > 0 and (obs_dir or lease_on)) or hang_abort_sec > 0):
+        _start_hang_watchdog()
+    stop_heartbeat()
     if heartbeat_sec > 0 and _STATE.tracker is not None:
-        stop_heartbeat()
-        hb = _ship.Heartbeat(
-            heartbeat_sec, _make_snapshot,
-            _STATE.tracker[0], _STATE.tracker[1], task_id,
-        ).start()
+        hb = _ship.Heartbeat(heartbeat_sec, _ship_metrics_snapshot).start()
         with _STATE.lock:
             _STATE.heartbeat = hb
+    if lease_on:
+        # immediate=True: the lease exists the moment the worker is up, so
+        # a worker frozen right after init is still covered.
+        lhb = _ship.Heartbeat(lease_sec, _renew_lease, immediate=True).start()
+        with _STATE.lock:
+            _STATE.lease_hb = lhb
 
 
 # -- collective spans --------------------------------------------------------
@@ -211,22 +244,40 @@ def _watchdog_loop() -> None:
     while True:
         with _STATE.lock:
             hang_sec = _STATE.hang_sec
-            obs_dir = _STATE.obs_dir
-            dumped = _STATE.hang_dumped
-            stuck = None
-            if hang_sec > 0:
-                now = time.monotonic()
-                for op, key, t0 in _STATE.inflight.values():
-                    if now - t0 > hang_sec:
-                        stuck = (op, key, now - t0)
-                        break
-        if obs_dir and not dumped and stuck is not None:
-            record_event("hang_detected", op=stuck[0], cache_key=stuck[1],
-                         stuck_seconds=round(stuck[2], 3))
-            dump_now("hang")
+            abort_sec = _STATE.hang_abort_sec
+            declared = _STATE.hang_dumped
+            now = time.monotonic()
+            worst: tuple[str, str | None, float] | None = None
+            for op, key, t0 in _STATE.inflight.values():
+                if worst is None or now - t0 > worst[2]:
+                    worst = (op, key, now - t0)
+        # Detection threshold: rabit_obs_hang_sec when set, else the abort
+        # bound alone drives it (abort without a separate dump threshold).
+        detect_sec = hang_sec if hang_sec > 0 else abort_sec
+        if (worst is not None and detect_sec > 0 and worst[2] > detect_sec
+                and not declared):
+            record_event("hang_detected", op=worst[0], cache_key=worst[1],
+                         stuck_seconds=round(worst[2], 3))
+            dump_now("hang")  # no-op without an obs dir
             with _STATE.lock:
                 _STATE.hang_dumped = True
-        time.sleep(min(1.0, hang_sec / 4.0) if hang_sec > 0 else 1.0)
+            declared = True
+        if worst is not None and abort_sec > 0 and worst[2] > abort_sec:
+            # Dump-then-die: evidence is already on disk (the declaration
+            # above); a second dump carries the abort decision itself, then
+            # the process exits so the launcher can restart it — the
+            # worker-side belt to the tracker lease's suspenders.
+            record_event("hang_abort", op=worst[0], cache_key=worst[1],
+                         stuck_seconds=round(worst[2], 3),
+                         exit_code=HANG_ABORT_EXIT)
+            dump_now("abort")
+            print(f"[rabit_tpu.obs] collective {worst[0]!r} stuck for "
+                  f"{worst[2]:.1f}s > rabit_hang_abort_sec={abort_sec}: "
+                  f"aborting (exit {HANG_ABORT_EXIT}) so the launcher can "
+                  f"restart this worker", flush=True, file=sys.stderr)
+            os._exit(HANG_ABORT_EXIT)
+        bounds = [b for b in (hang_sec, abort_sec) if b > 0]
+        time.sleep(max(min([1.0] + [b / 4.0 for b in bounds]), 0.02))
 
 
 def _start_hang_watchdog() -> None:
@@ -239,7 +290,7 @@ def _start_hang_watchdog() -> None:
     ).start()
 
 
-# -- shutdown shipping -------------------------------------------------------
+# -- periodic / shutdown shipping --------------------------------------------
 
 def _make_snapshot() -> dict:
     with _STATE.lock:
@@ -250,11 +301,42 @@ def _make_snapshot() -> dict:
     )
 
 
+def _ship_metrics_snapshot() -> bool:
+    """One metrics-heartbeat tick (runs on the heartbeat thread)."""
+    with _STATE.lock:
+        tracker, task_id = _STATE.tracker, _STATE.task_id
+    if tracker is None:
+        return False
+    return _ship.ship_snapshot(_make_snapshot(), tracker[0], tracker[1],
+                               task_id)
+
+
+def _renew_lease() -> bool:
+    """One lease-renewal tick (runs on the lease heartbeat thread).
+
+    Withheld once the watchdog has declared this process hung: a worker
+    stuck in a collective but still scheduling threads must look exactly as
+    dead to the tracker as a frozen one, so the lease detector covers both
+    silent-failure shapes."""
+    with _STATE.lock:
+        tracker = _STATE.tracker
+        rank, task_id = _STATE.rank, _STATE.task_id
+        interval = _STATE.heartbeat_sec
+        hung = _STATE.hang_dumped
+    if tracker is None or hung:
+        return False
+    return _ship.renew_lease(tracker[0], tracker[1], task_id, interval,
+                             rank=rank)
+
+
 def stop_heartbeat() -> None:
+    """Stop both periodic senders (metric snapshots and lease renewals)."""
     with _STATE.lock:
         hb, _STATE.heartbeat = _STATE.heartbeat, None
-    if hb is not None:
-        hb.stop()
+        lhb, _STATE.lease_hb = _STATE.lease_hb, None
+    for t in (hb, lhb):
+        if t is not None:
+            t.stop()
 
 
 def ship_final_snapshot() -> bool:

@@ -1,4 +1,4 @@
-"""Worker-side metric shipping over the tracker protocol.
+"""Worker-side tracker shipping: metric snapshots and heartbeat leases.
 
 Workers ship their metrics snapshot to the tracker as a ``CMD_METRICS``
 message (a JSON string on the same framed wire as ``CMD_PRINT``, see
@@ -6,15 +6,21 @@ rabit_tpu/tracker/protocol.py) — on shutdown always, and periodically when
 ``rabit_obs_heartbeat_sec`` > 0.  The tracker aggregates the latest
 snapshot per rank into the job-level ``telemetry.json``.
 
-Everything here is best-effort: observability must never fail a job, so a
-dead tracker or refused connection is swallowed (and counted on the
-registry so it is still visible in the next successful ship).
+With ``rabit_heartbeat_sec`` > 0 a second periodic sender renews a
+``CMD_HEARTBEAT`` lease (doc/fault_tolerance.md): the tracker suspects a
+worker whose lease lapses for ``LEASE_FACTOR`` intervals — the failure
+detector for SILENT deaths (frozen process, preempted VM) that never
+produce an exit code or a TCP error.
+
+Everything here rides :func:`rabit_tpu.tracker.protocol.tracker_rpc`, the
+one bounded/retrying client path, and is best-effort: observability must
+never fail a job, so a dead tracker or refused connection is swallowed (a
+missed lease renewal is healed by the next tick — the lease tolerates one).
 """
 
 from __future__ import annotations
 
 import json
-import socket
 import threading
 from typing import Callable
 
@@ -40,30 +46,50 @@ def build_snapshot(registry, rank: int, task_id: str, host: str = "",
 
 
 def ship_snapshot(snapshot: dict, tracker_host: str, tracker_port: int,
-                  task_id: str, timeout: float = 5.0) -> bool:
+                  task_id: str, timeout: float = 5.0, retries: int = 0) -> bool:
     """Send one snapshot; True on ACK.  Raises nothing."""
     try:
-        with socket.create_connection(
-            (tracker_host, int(tracker_port)), timeout=timeout
-        ) as sock:
-            P.send_hello(sock, P.CMD_METRICS, task_id,
-                         message=json.dumps(snapshot))
-            return P.get_u32(sock) == P.ACK
-    except (OSError, ValueError):
+        return P.tracker_rpc(
+            tracker_host, tracker_port, P.CMD_METRICS, task_id,
+            message=json.dumps(snapshot), timeout=timeout, retries=retries,
+        ) == P.ACK
+    except (P.TrackerUnreachable, ValueError):
+        return False
+
+
+def renew_lease(tracker_host: str, tracker_port: int, task_id: str,
+                interval: float, rank: int = -1,
+                timeout: float | None = None) -> bool:
+    """Renew this worker's heartbeat lease; True on ACK.  Raises nothing.
+
+    No retries: a renewal that misses its window is worthless — the next
+    tick is the retry, and the tracker-side lease tolerates one miss
+    (``LEASE_FACTOR``).  The send is bounded by ``timeout`` (default: one
+    interval) so a wedged tracker cannot back the sender up."""
+    try:
+        return P.tracker_rpc(
+            tracker_host, tracker_port, P.CMD_HEARTBEAT, task_id,
+            prev_rank=rank, message=repr(float(interval)),
+            timeout=timeout if timeout is not None else max(interval, 0.2),
+            retries=0,
+        ) == P.ACK
+    except (P.TrackerUnreachable, ValueError):
         return False
 
 
 class Heartbeat:
-    """Daemon thread shipping a fresh snapshot every ``interval`` seconds
-    until stopped.  ``make_snapshot`` is called on the heartbeat thread —
-    the registry is thread-safe by contract."""
+    """Daemon thread invoking ``ship()`` every ``interval`` seconds until
+    stopped — the one periodic-sender mechanism, used for both metric
+    snapshots and lease renewals.  ``ship`` runs on the heartbeat thread;
+    whatever it reads must be thread-safe by contract.  ``immediate=True``
+    fires once at start() so a lease exists before the first full interval
+    elapses."""
 
-    def __init__(self, interval: float, make_snapshot: Callable[[], dict],
-                 tracker_host: str, tracker_port: int, task_id: str):
+    def __init__(self, interval: float, ship: Callable[[], object],
+                 immediate: bool = False):
         self._interval = max(float(interval), 0.05)
-        self._make_snapshot = make_snapshot
-        self._addr = (tracker_host, int(tracker_port))
-        self._task_id = task_id
+        self._ship = ship
+        self._immediate = immediate
         self._stop = threading.Event()
         self._thread = threading.Thread(
             target=self._run, name="rabit-obs-heartbeat", daemon=True
@@ -78,6 +104,7 @@ class Heartbeat:
         self._thread.join(timeout=2.0)
 
     def _run(self) -> None:
+        if self._immediate:
+            self._ship()
         while not self._stop.wait(self._interval):
-            ship_snapshot(self._make_snapshot(), self._addr[0], self._addr[1],
-                          self._task_id)
+            self._ship()

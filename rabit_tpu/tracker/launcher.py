@@ -7,6 +7,12 @@ processes with the tracker's address in their environment, and restarts any
 worker that dies (nonzero exit) up to ``max_restarts`` times — which is how
 multi-node fault tolerance is tested on one machine.
 
+Self-healing (doc/fault_tolerance.md): the tracker's heartbeat-lease
+failure detector calls back into the launcher when a worker goes silent
+(``on_suspect``), and the launcher SIGKILLs the suspect — converting a
+SILENT hang (frozen process, preempted VM) into the ordinary death shape
+the restart path and the engines' wave-based recovery already handle.
+
 Usage:
     python -m rabit_tpu.tracker.launcher --num-workers 4 \
         [--max-restarts 20] -- python worker_prog.py [args...]
@@ -19,6 +25,7 @@ import os
 import signal
 import subprocess
 import sys
+import threading
 import time
 
 from rabit_tpu.tracker.tracker import Tracker
@@ -61,11 +68,32 @@ class LocalCluster:
         self.events: list[dict] = []
         self.telemetry: dict | None = None
         # time.time() at each observed worker death (recovery-latency
-        # benchmarks diff these against worker-reported recovery stamps)
+        # benchmarks diff these against worker-reported recovery stamps).
+        # Preemptions are stamped when the SIGKILL is confirmed delivered —
+        # including via the deferred-reap path — not when the restart branch
+        # later reaps them, so benchmark latencies start at the actual kill.
         self.death_times: list[float] = []
         # how many scheduled preemptions were actually delivered (a target
         # that already exited cleanly is left alone and not counted)
         self.preempts_delivered = 0
+        # how many scheduled SIGSTOP wedges landed (silent-hang injection),
+        # and time.time() at each — liveness tests diff these against the
+        # tracker's lease_expired timestamps for detection latency
+        self.wedges_delivered = 0
+        self.wedge_times: list[float] = []
+        # task ids the tracker's lease monitor suspected; drained by the
+        # poll loop, which SIGKILLs them (the monitor thread never touches
+        # procs[] directly — all process state stays on the run() thread)
+        self._suspects: list[str] = []
+        self._suspect_lock = threading.Lock()
+        # indices whose death was already stamped into death_times by the
+        # preemption path (the restart branch must not stamp them twice)
+        self._death_stamped: set[int] = set()
+
+    def _on_suspect(self, task_id: str) -> None:
+        """Tracker lease-monitor callback (runs on the monitor thread)."""
+        with self._suspect_lock:
+            self._suspects.append(task_id)
 
     def _spawn(self, cmd: list[str], tracker: Tracker, i: int) -> subprocess.Popen:
         env = dict(os.environ)
@@ -83,6 +111,7 @@ class LocalCluster:
         cmd: list[str],
         timeout: float = 300.0,
         preempt: list[tuple[float, int]] | None = None,
+        wedge: list[tuple[float, int]] | None = None,
     ) -> int:
         """Run ``cmd`` x num_workers under a fresh tracker.  Returns 0 when
         every worker exited cleanly; raises on restart-budget exhaustion or
@@ -95,14 +124,23 @@ class LocalCluster:
         north star: "checkpoint-recover under induced preemption"), the
         complement of the mock engine's deterministic kill points.  The
         killed worker is restarted from the normal budget like any other
-        death."""
-        tracker = Tracker(self.num_workers, quiet=self.quiet).start()
+        death.
+
+        ``wedge`` schedules SILENT hangs: ``[(delay_s, rank), ...]``
+        SIGSTOPs that worker instead — no exit, no TCP error, its sockets
+        stay open and its peers just block.  With heartbeat leases enabled
+        (``rabit_heartbeat_sec`` on the workers) the tracker suspects the
+        frozen worker, this launcher SIGKILLs it, and the hang becomes an
+        ordinary recoverable death."""
+        tracker = Tracker(self.num_workers, quiet=self.quiet,
+                          on_suspect=self._on_suspect).start()
         self.messages = tracker.messages
         self.events = tracker.events
         procs = [self._spawn(cmd, tracker, i) for i in range(self.num_workers)]
         start = time.monotonic()
         deadline = start + timeout
         pending = sorted(preempt or [], key=lambda p: p[0], reverse=True)
+        wedges = sorted(wedge or [], key=lambda p: p[0], reverse=True)
         reap_pending: set[int] = set()  # killed, reap deferred to poll loop
         try:
             while True:
@@ -120,6 +158,7 @@ class LocalCluster:
                     if proc is None:
                         continue  # finished cleanly — nothing to preempt
                     proc.kill()
+                    killed_at = time.time()
                     # kill() on a child that exited between the poll()
                     # above and here is a silent no-op; only count the
                     # preemption as delivered when the reaped status shows
@@ -132,11 +171,46 @@ class LocalCluster:
                         rc = proc.wait(timeout=0.5)
                         if rc == -signal.SIGKILL:
                             self.preempts_delivered += 1
+                            # Stamp the death at the kill, not at the later
+                            # restart reap — recovery-latency benchmarks
+                            # measure from the real preemption instant.
+                            self.death_times.append(killed_at)
+                            self._death_stamped.add(idx)
                     except subprocess.TimeoutExpired:
                         reap_pending.add(idx)
                     if not self.quiet:
                         print(f"[launcher] preempted worker {idx} "
                               f"(SIGKILL)", flush=True)
+                while wedges and time.monotonic() - start >= wedges[-1][0]:
+                    _, idx = wedges[-1]
+                    wedges.pop()
+                    proc = procs[idx]
+                    if proc is None or proc.poll() is not None:
+                        continue  # already gone — nothing to freeze
+                    proc.send_signal(signal.SIGSTOP)
+                    self.wedges_delivered += 1
+                    self.wedge_times.append(time.time())
+                    if not self.quiet:
+                        print(f"[launcher] wedged worker {idx} (SIGSTOP)",
+                              flush=True)
+                with self._suspect_lock:
+                    suspects, self._suspects = self._suspects, []
+                for task_id in suspects:
+                    try:
+                        idx = int(task_id)
+                    except ValueError:
+                        continue  # not one of ours
+                    proc = procs[idx] if 0 <= idx < len(procs) else None
+                    if proc is None or proc.poll() is not None:
+                        continue  # already dead/finished; nothing to heal
+                    # Convert the silent hang into a death: SIGKILL works on
+                    # stopped processes too, peers get TCP resets, and the
+                    # normal restart/recovery path below takes over.
+                    proc.kill()
+                    if not self.quiet:
+                        print(f"[launcher] worker {idx} suspected by lease "
+                              f"monitor: SIGKILL to force recovery",
+                              flush=True)
                 alive = 0
                 for i, proc in enumerate(procs):
                     if proc is None:
@@ -146,6 +220,11 @@ class LocalCluster:
                         reap_pending.discard(i)
                         if ret == -signal.SIGKILL:
                             self.preempts_delivered += 1
+                            # Deferred-reap preemptions must land in
+                            # death_times too; reap time is the closest
+                            # observable stamp left.
+                            self.death_times.append(time.time())
+                            self._death_stamped.add(i)
                     if ret is None:
                         alive += 1
                     elif ret == 0:
@@ -160,7 +239,10 @@ class LocalCluster:
                                 f"budget ({self.max_restarts}) exhausted"
                             )
                         self.restarts[i] += 1
-                        self.death_times.append(time.time())
+                        if i in self._death_stamped:
+                            self._death_stamped.discard(i)
+                        else:
+                            self.death_times.append(time.time())
                         if not self.quiet:
                             print(
                                 f"[launcher] worker {i} died (code {ret}); "
@@ -192,6 +274,13 @@ def main(argv: list[str] | None = None) -> int:
         help="SIGKILL worker RANK DELAY seconds after launch, wherever it "
              "happens to be (repeatable; induced-preemption testing)",
     )
+    ap.add_argument(
+        "--wedge", action="append", default=[], metavar="DELAY:RANK",
+        help="SIGSTOP worker RANK DELAY seconds after launch — a silent "
+             "hang with no exit and no TCP error (repeatable; pair with "
+             "rabit_heartbeat_sec on the workers so the lease detector "
+             "converts the hang into a restart)",
+    )
     ap.add_argument("cmd", nargs=argparse.REMAINDER)
     args = ap.parse_args(argv)
     cmd = args.cmd
@@ -199,18 +288,24 @@ def main(argv: list[str] | None = None) -> int:
         cmd = cmd[1:]
     if not cmd:
         ap.error("worker command required after --")
-    preempt = []
-    for s in args.preempt:
-        try:
-            delay, rank = s.split(":")
-            preempt.append((float(delay), int(rank)))
-        except ValueError:
-            ap.error(f"--preempt wants DELAY:RANK pairs, got {s!r}")
-        if not 0 <= preempt[-1][1] < args.num_workers:
-            ap.error(f"--preempt rank {preempt[-1][1]} outside "
-                     f"0..{args.num_workers - 1}")
+
+    def parse_schedule(entries: list[str], flag: str) -> list[tuple[float, int]]:
+        out = []
+        for s in entries:
+            try:
+                delay, rank = s.split(":")
+                out.append((float(delay), int(rank)))
+            except ValueError:
+                ap.error(f"{flag} wants DELAY:RANK pairs, got {s!r}")
+            if not 0 <= out[-1][1] < args.num_workers:
+                ap.error(f"{flag} rank {out[-1][1]} outside "
+                         f"0..{args.num_workers - 1}")
+        return out
+
+    preempt = parse_schedule(args.preempt, "--preempt")
+    wedge = parse_schedule(args.wedge, "--wedge")
     cluster = LocalCluster(args.num_workers, args.max_restarts, quiet=args.quiet)
-    return cluster.run(cmd, timeout=args.timeout, preempt=preempt)
+    return cluster.run(cmd, timeout=args.timeout, preempt=preempt, wedge=wedge)
 
 
 if __name__ == "__main__":

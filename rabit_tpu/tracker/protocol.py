@@ -13,13 +13,17 @@ Message layout (all u32/i32 little-endian; strings are u32 length + utf-8):
 worker -> tracker (fresh connection per message):
     u32 MAGIC_HELLO
     u32 cmd          (CMD_START | CMD_RECOVER | CMD_PRINT | CMD_SHUTDOWN
-                      | CMD_METRICS)
+                      | CMD_METRICS | CMD_HEARTBEAT)
     i32 prev_rank    (-1 if never assigned; stable re-admission key is task_id)
     str task_id
     if start/recover: u32 listen_port   (worker binds BEFORE contacting tracker)
     if print:         str message
     if metrics:       str json_snapshot (rabit_tpu.obs.ship envelope; the
                       tracker folds it into the job-level telemetry.json)
+    if heartbeat:     str interval_sec  (decimal; the worker's renewal cadence.
+                      The tracker grants a lease of 2x this interval — one
+                      missed renewal is tolerated, two expire the lease and
+                      suspect the worker; see doc/fault_tolerance.md)
 
 tracker -> worker (start/recover reply, sent when the wave of world_size
 workers is complete):
@@ -40,8 +44,10 @@ worker <-> worker link handshake (both directions on connect/accept):
 
 from __future__ import annotations
 
+import random
 import socket
 import struct
+import time
 from dataclasses import dataclass, field
 
 MAGIC_HELLO = 0x7AB17001
@@ -54,6 +60,12 @@ CMD_RECOVER = 2
 CMD_PRINT = 3
 CMD_SHUTDOWN = 4
 CMD_METRICS = 5
+CMD_HEARTBEAT = 6
+
+#: How many renewal intervals a lease survives without a renewal.  2 means
+#: one lost/late heartbeat is tolerated; the second expires the lease, so a
+#: frozen worker is suspected within 2 x rabit_heartbeat_sec.
+LEASE_FACTOR = 2.0
 
 _U32 = struct.Struct("<I")
 _I32 = struct.Struct("<i")
@@ -165,6 +177,72 @@ def send_hello(
     out = [put_u32(MAGIC_HELLO), put_u32(cmd), put_i32(prev_rank), put_str(task_id)]
     if cmd in (CMD_START, CMD_RECOVER):
         out.append(put_u32(listen_port))
-    elif cmd in (CMD_PRINT, CMD_METRICS):
+    elif cmd in (CMD_PRINT, CMD_METRICS, CMD_HEARTBEAT):
         out.append(put_str(message))
     send_all(sock, b"".join(out))
+
+
+class TrackerUnreachable(ConnectionError):
+    """The tracker could not be reached (or never replied) within the retry
+    budget.  Raised by :func:`tracker_rpc` so callers can fail fast with a
+    clear diagnosis instead of blocking forever on a dead tracker."""
+
+
+def tracker_rpc(
+    host: str,
+    port: int,
+    cmd: int,
+    task_id: str,
+    *,
+    prev_rank: int = -1,
+    listen_port: int = 0,
+    message: str = "",
+    timeout: float = 10.0,
+    reply_timeout: float | None = None,
+    retries: int = 5,
+    backoff: float = 0.1,
+    backoff_cap: float = 2.0,
+    rng: random.Random | None = None,
+) -> "Assignment | int":
+    """The one resilient client path for every Python-side tracker message
+    (bootstrap check-ins, print, metrics, heartbeat, shutdown).
+
+    One RPC = fresh connection, hello, reply.  Every socket operation is
+    bounded: ``timeout`` covers connect and the control replies,
+    ``reply_timeout`` (default: ``timeout``) separately covers waiting for a
+    START/RECOVER assignment — the tracker legitimately holds those until
+    the wave of world_size check-ins is complete, so callers usually want a
+    larger bound there.  Transport failures (refused, reset, torn reply,
+    timed-out read) are retried up to ``retries`` more times with
+    exponential backoff plus jitter (``backoff * 2^attempt``, capped at
+    ``backoff_cap``, scaled by a uniform 0.5-1.0 factor so a restart wave
+    doesn't stampede the tracker); when the budget is exhausted the last
+    error surfaces as :class:`TrackerUnreachable`.
+
+    Returns the :class:`Assignment` for START/RECOVER, the u32 ACK value
+    otherwise.  Retrying START/RECOVER is safe: the tracker replaces a task
+    id's stale pending entry on re-check-in (Tracker._register).
+    """
+    rng = rng if rng is not None else random
+    retries = max(int(retries), 0)
+    last_err: Exception | None = None
+    for attempt in range(retries + 1):
+        try:
+            with socket.create_connection((host, int(port)), timeout=timeout) as sock:
+                sock.settimeout(timeout)
+                send_hello(sock, cmd, task_id, prev_rank=prev_rank,
+                           listen_port=listen_port, message=message)
+                if cmd in (CMD_START, CMD_RECOVER):
+                    sock.settimeout(reply_timeout if reply_timeout is not None
+                                    else timeout)
+                    return Assignment.recv(sock)
+                return get_u32(sock)
+        except (ConnectionError, OSError) as exc:  # socket.timeout is OSError
+            last_err = exc
+            if attempt < retries:
+                delay = min(backoff * (2 ** attempt), backoff_cap)
+                time.sleep(delay * (0.5 + 0.5 * rng.random()))
+    raise TrackerUnreachable(
+        f"tracker {host}:{port} unreachable: {retries + 1} attempt(s) failed "
+        f"(cmd={cmd}, task_id={task_id!r}); last error: {last_err!r}"
+    )

@@ -17,6 +17,14 @@ recovery waves; the robust engine's ``recover_stats``/``failure_detected``
 prints converted to events at ingest), accepts ``CMD_METRICS`` snapshots
 from workers, and writes ``telemetry.json`` into ``RABIT_OBS_DIR`` when
 the job ends.
+
+Liveness (doc/fault_tolerance.md): workers renewing a ``CMD_HEARTBEAT``
+lease get per-rank failure detection for SILENT failures — a preempted VM
+or frozen process stops renewing, its lease expires after
+``LEASE_FACTOR x interval``, the tracker emits a ``lease_expired`` event
+and invokes the pluggable ``on_suspect(task_id)`` callback.  The launcher
+wires that callback to SIGKILL-and-restart the suspect, converting a hang
+into the recoverable-death shape the wave-based recovery already handles.
 """
 
 from __future__ import annotations
@@ -27,6 +35,7 @@ import socket
 import threading
 import time
 from dataclasses import dataclass
+from typing import Callable
 
 from rabit_tpu.obs.events import event_from_stats_line
 from rabit_tpu.tracker import protocol as P
@@ -43,6 +52,25 @@ class _Pending:
     host: str
     prev_rank: int
     cmd: int = P.CMD_START
+
+
+def _conn_dead(conn: socket.socket) -> bool:
+    """True when the peer of a held-open connection has hung up (EOF/RST
+    visible without consuming data).  Workers never send past their hello,
+    so a readable-with-EOF socket means the worker abandoned this wave."""
+    try:
+        return conn.recv(1, socket.MSG_PEEK | socket.MSG_DONTWAIT) == b""
+    except (BlockingIOError, InterruptedError):
+        return False  # open and idle — the normal pending state
+    except OSError:
+        return True
+
+
+@dataclass
+class _Lease:
+    expires: float   # time.monotonic() deadline
+    interval: float  # the worker's renewal cadence (seconds)
+    rank: int        # rank the worker reported at renewal (-1 pre-assignment)
 
 
 def assign_ranks(
@@ -125,9 +153,23 @@ class Tracker:
     def __init__(self, world_size: int, host: str = "127.0.0.1", port: int = 0,
                  quiet: bool = False, topology: str = "auto",
                  host_order: list[str] | None = None,
-                 obs_dir: str | None = None):
+                 obs_dir: str | None = None,
+                 conn_timeout_sec: float = 60.0,
+                 on_suspect: Callable[[str], None] | None = None):
         self.world_size = world_size
         self.quiet = quiet
+        # Per-connection read deadline: a client that connects and sends a
+        # torn/partial hello must not pin a _handle thread (and its socket)
+        # forever — the read times out and the connection is dropped without
+        # wedging the pending wave.  0 disables (tests of the blocking path).
+        self.conn_timeout_sec = conn_timeout_sec
+        # Liveness hook: called (from the lease monitor thread) with the
+        # task_id whose heartbeat lease expired.  The launcher wires this to
+        # SIGKILL-and-restart; standalone deployments can plug in their own
+        # remediation.  Exceptions are swallowed — detection must not kill
+        # the tracker.
+        self.on_suspect = on_suspect
+        self._leases: dict[str, _Lease] = {}
         # Job-level telemetry (doc/observability.md): structured events
         # (bootstrap/recovery waves, recover_stats converted from prints),
         # the latest metric snapshot per rank (CMD_METRICS), restart
@@ -169,6 +211,8 @@ class Tracker:
     def start(self) -> "Tracker":
         self._thread = threading.Thread(target=self._serve, daemon=True)
         self._thread.start()
+        threading.Thread(target=self._lease_monitor, daemon=True,
+                         name="rabit-tracker-leases").start()
         return self
 
     def wait(self, timeout: float | None = None) -> bool:
@@ -176,6 +220,15 @@ class Tracker:
 
     def stop(self) -> None:
         self._done.set()
+        # shutdown() BEFORE close(): close() alone defers the real fd close
+        # while the serve thread is blocked in accept() (CPython keeps the
+        # fd alive for the in-flight call), leaving a "stopped" tracker
+        # listening — and serving — indefinitely.  shutdown() wakes the
+        # accept with an error immediately.
+        try:
+            self._srv.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
         try:
             self._srv.close()
         except OSError:
@@ -199,6 +252,11 @@ class Tracker:
 
     def _handle(self, conn: socket.socket, addr) -> None:
         try:
+            # Bound every hello read: a slow/torn client (partial hello,
+            # chaos-severed proxy stream) is dropped at the deadline instead
+            # of leaking this thread and its socket.
+            if self.conn_timeout_sec > 0:
+                conn.settimeout(self.conn_timeout_sec)
             magic = P.get_u32(conn)
             if magic != P.MAGIC_HELLO:
                 conn.close()
@@ -208,6 +266,15 @@ class Tracker:
             task_id = P.get_str(conn)
             if cmd in (P.CMD_START, P.CMD_RECOVER):
                 listen_port = P.get_u32(conn)
+                # The hello is complete; from here the connection only ever
+                # WAITS (held open until the wave completer answers it), so
+                # the read deadline comes off again.
+                conn.settimeout(None)
+                with self._lock:
+                    # A (re-)check-in supersedes any lease of the previous
+                    # life: the fresh worker renews once it is back up, and
+                    # a stale lease must not re-suspect it mid-bootstrap.
+                    self._leases.pop(task_id, None)
                 self._register(conn, addr[0], task_id, listen_port, prev_rank,
                                cmd)
                 # conn is answered (and closed) by the wave completer.
@@ -232,7 +299,16 @@ class Tracker:
                 msg = P.get_str(conn)
                 self._accept_snapshot(msg)
                 conn.sendall(P.put_u32(P.ACK))
+            elif cmd == P.CMD_HEARTBEAT:
+                msg = P.get_str(conn)
+                self._renew_lease(task_id, prev_rank, msg)
+                conn.sendall(P.put_u32(P.ACK))
             elif cmd == P.CMD_SHUTDOWN:
+                with self._lock:
+                    # A clean exit must not be suspected afterwards; drop
+                    # the lease BEFORE acking so the worker observing the
+                    # ACK observes the drop too.
+                    self._leases.pop(task_id, None)
                 conn.sendall(P.put_u32(P.ACK))
                 done = False
                 with self._lock:
@@ -265,22 +341,102 @@ class Tracker:
                 _Pending(conn, task_id, listen_port, host, prev_rank, cmd))
             if len(self._pending) < self.world_size:
                 return
+            # The wave is nominally full — but a worker that died or gave
+            # up after checking in would receive its assignment into a dead
+            # socket, wasting the whole wave and starving its own retry out
+            # of the next one.  Purge hung-up entries first; their tasks'
+            # re-check-ins complete a later, fully live wave.
+            dead = [p for p in self._pending if _conn_dead(p.conn)]
+            if dead:
+                for p in dead:
+                    try:
+                        p.conn.close()
+                    except OSError:
+                        pass
+                self._pending = [p for p in self._pending if p not in dead]
+                self.events.append({
+                    "ts": round(time.time(), 6), "kind": "wave_purged",
+                    "dropped": sorted(p.task_id for p in dead),
+                })
+                if len(self._pending) < self.world_size:
+                    return
             wave, self._pending = self._pending, []
             epoch = self._epoch
             self._epoch += 1
         self._assign_and_send(wave, epoch)
+
+    # -- liveness ----------------------------------------------------------
+
+    def _renew_lease(self, task_id: str, rank: int, payload: str) -> None:
+        """Grant/renew a heartbeat lease: the worker promises to renew every
+        ``interval`` seconds and is suspected after LEASE_FACTOR intervals
+        of silence.  The payload is the decimal interval (see protocol.py)."""
+        try:
+            interval = float(payload)
+        except ValueError:
+            return  # malformed heartbeat must not hurt the tracker
+        if not (0 < interval < 86400):
+            return
+        with self._lock:
+            self._leases[task_id] = _Lease(
+                time.monotonic() + P.LEASE_FACTOR * interval, interval, rank)
+
+    def _lease_monitor(self) -> None:
+        """Scan leases and suspect the silent.  An expired lease is removed
+        before ``on_suspect`` fires, so one hang produces exactly one
+        suspicion (the restarted life re-establishes its own lease)."""
+        while not self._done.wait(0.05):
+            now = time.monotonic()
+            expired: list[tuple[str, _Lease]] = []
+            with self._lock:
+                for task_id, lease in list(self._leases.items()):
+                    if now >= lease.expires:
+                        del self._leases[task_id]
+                        expired.append((task_id, lease))
+                for task_id, lease in expired:
+                    self.events.append({
+                        "ts": round(time.time(), 6), "kind": "lease_expired",
+                        "task_id": task_id, "rank": lease.rank,
+                        "interval": lease.interval,
+                        "overdue": round(now - lease.expires, 6),
+                    })
+            for task_id, lease in expired:
+                if not self.quiet:
+                    print(f"[tracker] lease expired for task {task_id} "
+                          f"(rank {lease.rank}, interval {lease.interval}s): "
+                          f"suspecting worker", flush=True)
+                if self.on_suspect is not None:
+                    try:
+                        self.on_suspect(task_id)
+                    except Exception:  # noqa: BLE001 — detection must survive
+                        pass
+
+    def live_tasks(self) -> list[str]:
+        """Task ids currently holding an unexpired lease."""
+        with self._lock:
+            return sorted(self._leases)
 
     # -- telemetry ---------------------------------------------------------
 
     def _accept_snapshot(self, payload: str) -> None:
         """Fold one CMD_METRICS JSON envelope into the per-rank table
         (latest per rank wins — a restarted life's final snapshot replaces
-        its dead predecessor's heartbeat)."""
+        its dead predecessor's heartbeat).  Snapshots with an out-of-range
+        rank are rejected at ingest: a malformed ``rank=-1`` (worker shipped
+        before its assignment) must not pollute the per-rank table that
+        telemetry.json presents as ground truth."""
         try:
             snap = json.loads(payload)
             rank = int(snap.get("rank", -1))
         except (ValueError, TypeError):
             return  # malformed snapshot must not hurt the tracker
+        if not 0 <= rank < self.world_size:
+            with self._lock:
+                self.events.append({
+                    "ts": round(time.time(), 6), "kind": "snapshot_rejected",
+                    "rank": rank, "task_id": str(snap.get("task_id", "")),
+                })
+            return
         with self._lock:
             self.snapshots[rank] = snap
             self.events.append({
@@ -304,6 +460,8 @@ class Tracker:
             "finished_at": round(time.time(), 6),
             "n_waves": len(waves),
             "n_recovery_waves": sum(1 for w in waves if w["epoch"] > 0),
+            "n_lease_expired": sum(1 for e in events
+                                   if e["kind"] == "lease_expired"),
             "restarts": restarts,
             "waves": waves,
             "events": events,

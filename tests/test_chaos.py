@@ -1,0 +1,293 @@
+"""Chaos suite (ISSUE 2): network-fault injection via the chaos proxy.
+
+Unit-tests the proxy's fault shapes, then fuzzes full bootstrap/recovery
+waves through it against a real tracker: schedules inject
+refuse/delay/truncate/blackhole faults, heal, and must CONVERGE — all
+workers agreeing on one epoch with stable distinct ranks — with every
+socket operation bounded, so "stuck" is a hard failure, never a silent
+hang.  The tier-1 subset runs a few dozen schedules; the ``slow``-marked
+run covers 200+ (scripts/runtest.sh, ``pytest -m slow``).
+
+Also the resilient-RPC acceptance: with the tracker truly gone, both the
+Python client path (tracker_rpc) and a native worker's bootstrap fail fast
+with a clear error after their bounded, backed-off retry budgets.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+import pytest
+
+from rabit_tpu.chaos import ChaosProxy, FaultSpec, run_schedule
+from rabit_tpu.tracker import protocol as P
+from rabit_tpu.tracker.tracker import Tracker
+
+REPO = Path(__file__).resolve().parents[1]
+BASIC_WORKER = str(REPO / "tests" / "workers" / "basic_worker.py")
+
+
+# -- proxy fault-shape units -------------------------------------------------
+
+class _Echo:
+    """One-connection-at-a-time TCP echo upstream."""
+
+    def __init__(self):
+        self.srv = socket.socket()
+        self.srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.srv.bind(("127.0.0.1", 0))
+        self.srv.listen(8)
+        self.addr = self.srv.getsockname()
+        self._stop = False
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def _loop(self):
+        while not self._stop:
+            try:
+                conn, _ = self.srv.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _serve(self, conn):
+        try:
+            while True:
+                data = conn.recv(4096)
+                if not data:
+                    return
+                conn.sendall(data)
+        except OSError:
+            pass
+        finally:
+            conn.close()
+
+    def close(self):
+        self._stop = True
+        try:
+            self.srv.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        self.srv.close()
+
+
+def test_proxy_passthrough_no_faults():
+    echo = _Echo()
+    proxy = ChaosProxy(echo.addr).start()
+    try:
+        with socket.create_connection((proxy.host, proxy.port), 5) as s:
+            s.settimeout(5)
+            payload = bytes(range(256)) * 64
+            s.sendall(payload)
+            got = b""
+            while len(got) < len(payload):
+                got += s.recv(4096)
+            assert got == payload
+        # the pump threads update stats after forwarding; allow them a beat
+        deadline = time.time() + 2
+        while (proxy.stats.bytes_forwarded < 2 * len(payload)
+               and time.time() < deadline):
+            time.sleep(0.01)
+        assert proxy.stats.bytes_forwarded >= 2 * len(payload)
+        assert proxy.stats.refused == 0
+    finally:
+        proxy.stop()
+        echo.close()
+
+
+def test_proxy_refuse_and_truncate():
+    echo = _Echo()
+    proxy = ChaosProxy(echo.addr, FaultSpec(p_refuse=1.0)).start()
+    try:
+        with socket.create_connection((proxy.host, proxy.port), 5) as s:
+            s.settimeout(5)
+            assert s.recv(1) == b""  # accepted then immediately closed
+        assert proxy.stats.refused == 1
+    finally:
+        proxy.stop()
+
+    proxy = ChaosProxy(echo.addr, FaultSpec(p_truncate=1.0,
+                                            truncate_bytes=(8, 8))).start()
+    try:
+        with socket.create_connection((proxy.host, proxy.port), 5) as s:
+            s.settimeout(5)
+            s.sendall(b"x" * 64)
+            got = b""
+            try:
+                while True:
+                    chunk = s.recv(4096)
+                    if not chunk:
+                        break
+                    got += chunk
+            except OSError:
+                pass  # severed mid-stream also shows as reset
+            assert len(got) <= 8  # only the prefix crossed
+        assert proxy.stats.truncated == 1
+    finally:
+        proxy.stop()
+        echo.close()
+
+
+def test_proxy_blackhole_and_partition():
+    echo = _Echo()
+    proxy = ChaosProxy(echo.addr, FaultSpec(p_blackhole=1.0)).start()
+    try:
+        with socket.create_connection((proxy.host, proxy.port), 5) as s:
+            s.settimeout(0.4)
+            s.sendall(b"hello?")
+            with pytest.raises(socket.timeout):
+                s.recv(1)  # open but silent — only deadlines catch this
+        assert proxy.stats.blackholed == 1
+    finally:
+        proxy.stop()
+
+    proxy = ChaosProxy(echo.addr).start()
+    try:
+        s = socket.create_connection((proxy.host, proxy.port), 5)
+        s.settimeout(5)
+        s.sendall(b"ping")
+        assert s.recv(4) == b"ping"
+        proxy.set_partition(True)
+        # established connection severed...
+        assert s.recv(1) == b""
+        s.close()
+        # ...and new ones refused while partitioned
+        with socket.create_connection((proxy.host, proxy.port), 5) as s2:
+            s2.settimeout(5)
+            assert s2.recv(1) == b""
+        proxy.set_partition(False)
+        with socket.create_connection((proxy.host, proxy.port), 5) as s3:
+            s3.settimeout(5)
+            s3.sendall(b"back")
+            assert s3.recv(4) == b"back"
+    finally:
+        proxy.stop()
+        echo.close()
+
+
+# -- resilient tracker RPC: fail-fast when the tracker is gone ---------------
+
+def test_tracker_rpc_fails_fast_when_tracker_gone():
+    # grab a port that nothing listens on
+    probe = socket.socket()
+    probe.bind(("127.0.0.1", 0))
+    port = probe.getsockname()[1]
+    probe.close()
+    t0 = time.monotonic()
+    with pytest.raises(P.TrackerUnreachable) as ei:
+        P.tracker_rpc("127.0.0.1", port, P.CMD_START, "0", listen_port=41000,
+                      timeout=0.5, retries=3, backoff=0.05)
+    elapsed = time.monotonic() - t0
+    assert elapsed < 5.0, elapsed  # bounded, not blocking indefinitely
+    assert "4 attempt(s)" in str(ei.value)
+    assert f"127.0.0.1:{port}" in str(ei.value)
+
+
+def test_native_bootstrap_fails_fast_when_tracker_gone():
+    """Acceptance: a native worker pointed at a dead tracker errors out
+    with a clear message after rabit_connect_retry backed-off attempts
+    instead of blocking indefinitely."""
+    probe = socket.socket()
+    probe.bind(("127.0.0.1", 0))
+    port = probe.getsockname()[1]
+    probe.close()
+    env = dict(os.environ)
+    env.update(
+        PYTHONPATH=f"{REPO}:{env.get('PYTHONPATH', '')}",
+        DMLC_TRACKER_URI="127.0.0.1",
+        DMLC_TRACKER_PORT=str(port),
+        DMLC_TASK_ID="0",
+    )
+    t0 = time.monotonic()
+    proc = subprocess.run(
+        [sys.executable, BASIC_WORKER, "rabit_engine=native",
+         "rabit_connect_retry=2", "100"],
+        env=env, capture_output=True, text=True, timeout=60,
+    )
+    elapsed = time.monotonic() - t0
+    assert proc.returncode != 0
+    assert elapsed < 30.0, elapsed
+    err = proc.stderr
+    assert "unreachable" in err and "rabit_connect_retry=2" in err, err
+
+
+# -- native bootstrap through a degraded network -----------------------------
+
+def test_native_bootstrap_through_flaky_tracker_path():
+    """Real native workers bootstrap and complete with the tracker behind a
+    proxy that comes up LATE (every early dial refused — exercising the
+    C++ connect retry/backoff) and then delays every forwarded chunk."""
+    tracker = Tracker(world_size=2, quiet=True).start()
+    # reserve the proxy's port before it exists so workers dial a dead
+    # address first
+    hold = socket.socket()
+    hold.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    hold.bind(("127.0.0.1", 0))
+    proxy_port = hold.getsockname()[1]
+    hold.close()
+
+    procs = []
+    for i in range(2):
+        env = dict(os.environ)
+        env.update(
+            PYTHONPATH=f"{REPO}:{env.get('PYTHONPATH', '')}",
+            DMLC_TRACKER_URI="127.0.0.1",
+            DMLC_TRACKER_PORT=str(proxy_port),
+            DMLC_TASK_ID=str(i),
+        )
+        procs.append(subprocess.Popen(
+            [sys.executable, BASIC_WORKER, "rabit_engine=native",
+             "rabit_connect_retry=8", "200"],
+            env=env, stdout=subprocess.DEVNULL, stderr=subprocess.PIPE,
+            text=True,
+        ))
+    proxy = None
+    try:
+        time.sleep(1.0)  # workers are burning connect retries
+        proxy = ChaosProxy((tracker.host, tracker.port),
+                           FaultSpec(delay=(0.0, 0.02)), seed=3,
+                           listen_port=proxy_port).start()
+        deadline = time.time() + 60
+        while time.time() < deadline and any(p.poll() is None for p in procs):
+            time.sleep(0.1)
+        rcs = [p.poll() for p in procs]
+        errs = [p.stderr.read() if p.stderr else "" for p in procs]
+        assert rcs == [0, 0], f"exit codes {rcs}\n" + "\n".join(errs)
+        assert proxy.stats.connections > 0
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+        if proxy is not None:
+            proxy.stop()
+        tracker.stop()
+
+
+# -- fuzzed bootstrap/recovery schedules -------------------------------------
+
+def _assert_schedules(seed_base: int, n: int) -> None:
+    for seed in range(seed_base, seed_base + n):
+        r = run_schedule(seed)
+        assert r.completed, f"seed {seed} did not converge: {r}"
+        assert sorted(r.rank_of.values()) == list(range(r.world)), r
+        assert r.epoch >= 0
+
+
+def test_fuzz_bootstrap_recovery_fast_subset():
+    """Tier-1 subset: a few dozen fuzzed schedules must all converge with
+    zero hangs (each RPC is bounded; a stuck thread fails the schedule)."""
+    _assert_schedules(0, 30)
+
+
+@pytest.mark.slow
+def test_fuzz_bootstrap_recovery_full():
+    """The full acceptance sweep: 200+ fuzzed schedules (run via
+    ``pytest -m slow`` or tools/chaos_bench.py --schedules 200)."""
+    _assert_schedules(0, 200)

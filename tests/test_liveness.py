@@ -1,0 +1,361 @@
+"""Liveness layer (ISSUE 2): heartbeat-lease failure detection, hang
+escalation, and wave integrity under torn bootstraps.
+
+The failure shapes here are SILENT — no exit code, no TCP error.  A
+preempted VM or frozen worker just stops; before this layer the job idled
+until the outer watchdog.  Now: the tracker's lease detector suspects the
+silent worker within ``LEASE_FACTOR x rabit_heartbeat_sec``, the launcher
+SIGKILLs it, and the ordinary wave-based recovery completes the job — and
+on the worker side ``rabit_hang_abort_sec`` makes a stuck rank dump its
+flight recorder and die so it can be restarted (dump-then-die).
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+import pytest
+
+from rabit_tpu.obs import HANG_ABORT_EXIT
+from rabit_tpu.obs.ship import build_snapshot, renew_lease, ship_snapshot
+from rabit_tpu.tracker import protocol as P
+from rabit_tpu.tracker.launcher import LocalCluster
+from rabit_tpu.tracker.tracker import Tracker
+
+REPO = Path(__file__).resolve().parents[1]
+RECOVER_WORKER = str(REPO / "tests" / "workers" / "recover_worker.py")
+
+
+# -- lease detector (tracker side) -------------------------------------------
+
+def test_lease_renewal_keeps_worker_live():
+    suspected: list[str] = []
+    tracker = Tracker(world_size=2, quiet=True,
+                      on_suspect=suspected.append).start()
+    try:
+        deadline = time.time() + 1.0
+        while time.time() < deadline:
+            assert renew_lease(tracker.host, tracker.port, "3", 0.2, rank=1)
+            time.sleep(0.1)
+        assert suspected == []
+        assert tracker.live_tasks() == ["3"]
+    finally:
+        tracker.stop()
+
+
+def test_lease_expiry_suspects_within_two_intervals():
+    suspected: list[str] = []
+    tracker = Tracker(world_size=2, quiet=True,
+                      on_suspect=suspected.append).start()
+    try:
+        interval = 0.2
+        assert renew_lease(tracker.host, tracker.port, "5", interval, rank=1)
+        silent_at = time.time()
+        while not suspected and time.time() - silent_at < 3.0:
+            time.sleep(0.01)
+        detect = time.time() - silent_at
+        assert suspected == ["5"]
+        # the acceptance bound: detection within LEASE_FACTOR x interval
+        # (plus the 50ms monitor scan granularity and some scheduler slack)
+        assert detect < P.LEASE_FACTOR * interval + 0.3, detect
+        evs = [e for e in tracker.events if e["kind"] == "lease_expired"]
+        assert len(evs) == 1 and evs[0]["task_id"] == "5"
+        assert evs[0]["rank"] == 1 and evs[0]["interval"] == interval
+        assert tracker.live_tasks() == []
+        # one hang -> exactly one suspicion: no re-fire without a renewal
+        time.sleep(3 * interval)
+        assert suspected == ["5"]
+    finally:
+        tracker.stop()
+
+
+def test_lease_cleared_by_shutdown_and_checkin():
+    suspected: list[str] = []
+    tracker = Tracker(world_size=1, quiet=True,
+                      on_suspect=suspected.append).start()
+    try:
+        assert renew_lease(tracker.host, tracker.port, "0", 0.15)
+        # a clean shutdown drops the lease: no posthumous suspicion
+        assert P.tracker_rpc(tracker.host, tracker.port, P.CMD_SHUTDOWN,
+                             "0", timeout=2.0, retries=0) == P.ACK
+        assert tracker.live_tasks() == []
+        time.sleep(0.5)
+        assert suspected == []
+    finally:
+        tracker.stop()
+
+    suspected2: list[str] = []
+    tracker2 = Tracker(world_size=1, quiet=True,
+                       on_suspect=suspected2.append).start()
+    try:
+        # a (re-)check-in supersedes the previous life's lease: the stale
+        # lease must not suspect the fresh life mid-bootstrap
+        assert renew_lease(tracker2.host, tracker2.port, "0", 0.15)
+        asg = P.tracker_rpc(tracker2.host, tracker2.port, P.CMD_START, "0",
+                            listen_port=50000, timeout=2.0, retries=0)
+        assert isinstance(asg, P.Assignment) and asg.rank == 0
+        time.sleep(0.6)
+        assert suspected2 == []
+    finally:
+        tracker2.stop()
+
+
+def test_malformed_heartbeat_ignored():
+    tracker = Tracker(world_size=1, quiet=True).start()
+    try:
+        assert P.tracker_rpc(tracker.host, tracker.port, P.CMD_HEARTBEAT,
+                             "0", message="banana", timeout=2.0,
+                             retries=0) == P.ACK
+        assert P.tracker_rpc(tracker.host, tracker.port, P.CMD_HEARTBEAT,
+                             "0", message="-3.0", timeout=2.0,
+                             retries=0) == P.ACK
+        assert tracker.live_tasks() == []
+    finally:
+        tracker.stop()
+
+
+# -- end-to-end self-healing (the acceptance scenario) -----------------------
+
+def test_silent_hang_detected_killed_restarted_job_completes():
+    """A worker frozen mid-collective (SIGSTOP: no exit, no TCP error) is
+    suspected via lease expiry, SIGKILLed by the launcher, restarted, and
+    the self-verifying job completes with bitwise-correct results; the
+    telemetry timeline shows lease_expired followed by a recovery wave."""
+    hb = 0.25
+    cluster = LocalCluster(3, max_restarts=5, quiet=True)
+    rc = cluster.run(
+        [sys.executable, RECOVER_WORKER,
+         "rabit_engine=robust", "ndata=2000", "niter=6", "sleep=0.4",
+         f"rabit_heartbeat_sec={hb}",
+         "rabit_stall_timeout_sec=1", "rabit_timeout_sec=60"],
+        timeout=120.0,
+        wedge=[(1.3, 1)],
+    )
+    assert rc == 0
+    assert cluster.returncodes == [0, 0, 0]
+    assert cluster.wedges_delivered == 1
+    assert cluster.restarts[1] >= 1, "the wedged worker was never restarted"
+
+    t = cluster.telemetry
+    assert t is not None
+    leases = [e for e in t["events"] if e["kind"] == "lease_expired"]
+    assert leases and leases[0]["task_id"] == "1", t["events"]
+    assert t["n_lease_expired"] >= 1
+    # detection latency: silence starts at the SIGSTOP; the lease is at
+    # most one renewal old at that point, so the bound is
+    # (1 + LEASE_FACTOR) x interval plus scan/RPC slack
+    detect = leases[0]["ts"] - cluster.wedge_times[0]
+    assert 0 < detect < (1 + P.LEASE_FACTOR) * hb + 0.75, detect
+    # the lease expiry must be what triggered the recovery wave
+    recovery = [w for w in t["waves"] if w["epoch"] > 0]
+    assert recovery, t["waves"]
+    assert any(w["ts"] > leases[0]["ts"] and "1" in w["restarted"]
+               for w in recovery), (leases, recovery)
+    assert t["restarts"].get("1", 0) >= 1
+
+
+def test_hang_abort_dump_then_die(tmp_path):
+    """Worker-side escalation: survivors stuck in a collective past
+    rabit_hang_abort_sec dump their flight recorder and abort with
+    HANG_ABORT_EXIT so a launcher can restart them."""
+    obs_dir = tmp_path / "obs"
+    ready = tmp_path / "ready"
+    ready.mkdir()
+    worker = tmp_path / "worker.py"
+    worker.write_text(
+        "import os, time\n"
+        "import numpy as np\n"
+        "import rabit_tpu as rt\n"
+        "rt.init()\n"
+        "rank = rt.get_rank()\n"
+        "open(os.environ['READY_DIR'] + f'/ready.{rank}', 'w').write('1')\n"
+        "for it in range(200):\n"
+        "    rt.allreduce(np.full(8, float(it), np.float64), rt.SUM)\n"
+        "    time.sleep(0.05)\n"
+        "rt.finalize()\n"
+    )
+    world = 3
+    tracker = Tracker(world_size=world, quiet=True).start()
+    procs = []
+    for i in range(world):
+        env = dict(os.environ)
+        env.update(
+            PYTHONPATH=f"{REPO}:{env.get('PYTHONPATH', '')}",
+            DMLC_TRACKER_URI=tracker.host,
+            DMLC_TRACKER_PORT=str(tracker.port),
+            DMLC_TASK_ID=str(i),
+            READY_DIR=str(ready),
+            RABIT_OBS_DIR=str(obs_dir),
+        )
+        procs.append(subprocess.Popen(
+            [sys.executable, str(worker), "rabit_engine=native",
+             "rabit_obs_hang_sec=0.5", "rabit_hang_abort_sec=1.5",
+             # native detectors parked outside the window: the obs
+             # escalation must be what fires
+             "rabit_stall_timeout_sec=120", "rabit_timeout_sec=120"],
+            env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        ))
+    try:
+        deadline = time.time() + 60
+        while time.time() < deadline and len(list(ready.iterdir())) < world:
+            time.sleep(0.05)
+        assert len(list(ready.iterdir())) == world, "workers did not init"
+        time.sleep(0.3)
+        os.kill(procs[1].pid, signal.SIGSTOP)
+        survivors = [procs[0], procs[2]]
+        deadline = time.time() + 30
+        while time.time() < deadline and any(p.poll() is None
+                                             for p in survivors):
+            time.sleep(0.1)
+        rcs = [p.poll() for p in survivors]
+        assert rcs == [HANG_ABORT_EXIT, HANG_ABORT_EXIT], rcs
+        assert procs[1].poll() is None  # the frozen one is still stopped
+        hang_dumps = sorted(obs_dir.glob("flight-*-hang.jsonl"))
+        abort_dumps = sorted(obs_dir.glob("flight-*-abort.jsonl"))
+        assert len(hang_dumps) >= 2 and len(abort_dumps) >= 2, \
+            list(obs_dir.iterdir())
+        from rabit_tpu.obs.events import load_dump
+
+        kinds = [e.kind for e in load_dump(abort_dumps[0])]
+        assert "hang_detected" in kinds and "hang_abort" in kinds
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+        tracker.stop()
+
+
+# -- wave integrity under torn bootstrap (satellites) ------------------------
+
+def _boot_thread(tracker, task_id, results, cmd=P.CMD_START):
+    def run():
+        results[task_id] = P.tracker_rpc(
+            tracker.host, tracker.port, cmd, task_id,
+            listen_port=41000 + int(task_id), timeout=2.0, reply_timeout=20.0,
+            retries=0)
+    th = threading.Thread(target=run, daemon=True)
+    th.start()
+    return th
+
+
+def test_worker_death_between_hello_and_reply_does_not_stall_wave():
+    """A worker killed between its CMD_START hello and the assignment reply
+    leaves a dead pending connection.  The wave must complete as soon as
+    its restart re-checks in — via stale-entry replacement when the restart
+    arrives before the wave fills, via the dead-connection purge when the
+    wave would otherwise fire into the corpse."""
+    # Path 1: restart re-checks in while the wave is still filling
+    # (_register replaces the stale entry).
+    tracker = Tracker(world_size=3, quiet=True).start()
+    try:
+        s = socket.create_connection((tracker.host, tracker.port), timeout=5)
+        P.send_hello(s, P.CMD_START, "0", listen_port=41000)
+        s.close()  # dies with its hello registered, reply never readable
+        results: dict[str, P.Assignment] = {}
+        threads = [_boot_thread(tracker, t, results) for t in ("0", "1", "2")]
+        for th in threads:
+            th.join(timeout=25)
+            assert not th.is_alive(), "wave stalled past the restart"
+        assert sorted(a.rank for a in results.values()) == [0, 1, 2]
+        assert results["0"].rank == 0  # launcher numbering preserved
+    finally:
+        tracker.stop()
+
+    # Path 2: the wave fills with the corpse still registered — the tracker
+    # must purge it at fill time and wait for the restart instead of
+    # wasting the wave on a dead socket.
+    tracker = Tracker(world_size=3, quiet=True).start()
+    try:
+        s = socket.create_connection((tracker.host, tracker.port), timeout=5)
+        P.send_hello(s, P.CMD_START, "0", listen_port=41000)
+        s.close()
+        results = {}
+        threads = [_boot_thread(tracker, t, results) for t in ("1", "2")]
+        deadline = time.time() + 10
+        while time.time() < deadline and not any(
+                e["kind"] == "wave_purged" for e in tracker.events):
+            time.sleep(0.02)
+        assert any(e["kind"] == "wave_purged" and e["dropped"] == ["0"]
+                   for e in tracker.events), tracker.events
+        threads.append(_boot_thread(tracker, "0", results))  # the restart
+        for th in threads:
+            th.join(timeout=25)
+            assert not th.is_alive(), "wave stalled past the restart"
+        assert sorted(a.rank for a in results.values()) == [0, 1, 2]
+        assert {a.epoch for a in results.values()} == {0}
+    finally:
+        tracker.stop()
+
+
+def test_torn_hello_connection_dropped_without_wedging(tmp_path):
+    """A client that connects and sends a PARTIAL hello must not pin a
+    handler thread/socket forever: the per-connection deadline drops it and
+    later waves proceed normally."""
+    tracker = Tracker(world_size=1, quiet=True, conn_timeout_sec=0.3).start()
+    try:
+        torn = socket.create_connection((tracker.host, tracker.port),
+                                        timeout=5)
+        torn.sendall(P.put_u32(P.MAGIC_HELLO))  # ...and nothing more
+        # the tracker must hang up on the torn connection at the deadline
+        torn.settimeout(5.0)
+        assert torn.recv(16) == b""
+        torn.close()
+        # the pending wave is unaffected: a real check-in completes at once
+        asg = P.tracker_rpc(tracker.host, tracker.port, P.CMD_START, "0",
+                            listen_port=41000, timeout=2.0, retries=0)
+        assert isinstance(asg, P.Assignment) and asg.rank == 0
+    finally:
+        tracker.stop()
+
+
+def test_snapshot_rank_validated_at_ingest():
+    """CMD_METRICS snapshots with out-of-range ranks (the malformed
+    ``rank=-1`` shape) are rejected at ingest instead of polluting the
+    per-rank telemetry table."""
+    from rabit_tpu.obs.metrics import MetricsRegistry
+
+    tracker = Tracker(world_size=2, quiet=True).start()
+    try:
+        reg = MetricsRegistry()
+        reg.observe_op("allreduce", 64, 0.001)
+        for bad_rank in (-1, 2, 99):
+            assert ship_snapshot(build_snapshot(reg, bad_rank, "t"),
+                                 tracker.host, tracker.port, "t")
+        assert ship_snapshot(build_snapshot(reg, 1, "1"),
+                             tracker.host, tracker.port, "1")
+        deadline = time.time() + 5
+        while time.time() < deadline and 1 not in tracker.snapshots:
+            time.sleep(0.02)
+        assert set(tracker.snapshots) == {1}
+        rejected = [e for e in tracker.events
+                    if e["kind"] == "snapshot_rejected"]
+        assert sorted(e["rank"] for e in rejected) == [-1, 2, 99]
+        assert set(tracker.build_telemetry()["ranks"]) == {"1"}
+    finally:
+        tracker.stop()
+
+
+def test_death_times_recorded_for_preemptions():
+    """SIGKILL preemptions land in death_times exactly once (stamped at the
+    kill, not double-counted by the restart branch), so recovery-latency
+    benchmarks see preemptions too."""
+    cluster = LocalCluster(2, max_restarts=3, quiet=True)
+    rc = cluster.run(
+        [sys.executable, RECOVER_WORKER,
+         "rabit_engine=robust", "ndata=500", "niter=4", "sleep=0.4"],
+        timeout=90.0,
+        preempt=[(1.0, 1)],
+    )
+    assert rc == 0
+    assert cluster.preempts_delivered == 1
+    assert cluster.restarts[1] >= 1
+    # exactly one death happened; it must appear exactly once
+    assert len(cluster.death_times) == cluster.restarts[0] + cluster.restarts[1]

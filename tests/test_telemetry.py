@@ -126,8 +126,9 @@ def test_cmd_metrics_wire_and_heartbeat(tmp_path):
         assert tracker.snapshots[0]["metrics"]["ops"]["allreduce"]["calls"] == 1
 
         reg.observe_op("allreduce", 64, 0.002)
-        hb = Heartbeat(0.05, lambda: build_snapshot(reg, 0, "0"),
-                       tracker.host, tracker.port, "0").start()
+        hb = Heartbeat(0.05, lambda: ship_snapshot(
+            build_snapshot(reg, 0, "0"), tracker.host, tracker.port,
+            "0")).start()
         deadline = _time.time() + 5
         while (_time.time() < deadline and
                tracker.snapshots[0]["metrics"]["ops"]["allreduce"]["calls"] < 2):
