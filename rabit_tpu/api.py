@@ -137,6 +137,9 @@ def finalize() -> None:
         obs.record_event("engine_finalize", engine=type(_engine).__name__)
         _engine.shutdown()
         _engine = None
+        # rabit_trace_exit=1: leave this life's ring as a -exit flight dump
+        # so the cross-rank trace merger has per-rank evidence of CLEAN runs
+        obs.dump_final()
     _ckpt_store = None
     _ckpt_base = 0
 
@@ -313,6 +316,10 @@ def load_checkpoint(with_local: bool = False):
             # process state starts empty).
             _ckpt_base, gblob = _unwrap(gblob)
             version = _ckpt_base + version
+    # Cross-rank collective numbering (obs/trace.py): landing on version V
+    # resets the per-version seqno exactly like the survivors' commit of V
+    # did, so a restarted worker resumes the shared (version, seqno) line.
+    obs.collective_epoch(version)
     obs.record_event("load_checkpoint", version=version,
                      recovered=version > 0)
     if version > 0:
@@ -328,6 +335,7 @@ def _note_commit(engine: Engine, nbytes: int) -> None:
     """Record one checkpoint commit (engine version bump) in the flight
     recorder and registry."""
     version = _ckpt_base + engine.version_number()
+    obs.collective_epoch(version)
     obs.record_event("checkpoint_commit", version=version, nbytes=nbytes)
     reg = obs.get_registry()
     reg.counter("checkpoint_commits_total").inc()

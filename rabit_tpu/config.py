@@ -91,6 +91,14 @@ DEFAULTS: dict[str, str] = {
     # worker-side belt to the tracker lease's suspenders.
     "rabit_heartbeat_sec": "0",
     "rabit_hang_abort_sec": "0",
+    # Cross-rank tracing (rabit_tpu/obs/trace.py, tools/trace_tool.py).
+    # rabit_trace_exit=1: dump the flight ring as flight-*-exit.jsonl at
+    # finalize, so CLEAN runs leave the per-rank evidence the job-wide
+    # trace merger joins.  rabit_trace_clock_pings: timestamped
+    # round-trips at shutdown that (re)estimate this rank's clock offset
+    # against the tracker before the final snapshot ships it.
+    "rabit_trace_exit": "0",
+    "rabit_trace_clock_pings": "2",
     # Default ON, matching the native engine (see comm.cc Configure): with
     # Nagle on, every cold-direction header write stalls ~40ms behind the
     # peer's delayed ACK — measured 44ms/op on loopback object broadcasts.

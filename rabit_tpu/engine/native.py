@@ -13,6 +13,7 @@ import ctypes
 import os
 import subprocess
 import threading
+import time
 from pathlib import Path
 from typing import Callable
 
@@ -128,6 +129,7 @@ class NativeEngine(Engine):
         args = [f"{k}={v}".encode() for k, v in cfg.items()]
         arr = (ctypes.c_char_p * len(args))(*args)
         self.obs_event("engine_init", backend=self._kind)
+        t0 = time.time()
         try:
             self._check(self._lib.RabitInit(len(args), arr), "init")
         except NativeError as exc:
@@ -149,12 +151,14 @@ class NativeEngine(Engine):
             raise
         # (Re)bootstrap complete: the assignment is live.  Restarted lives
         # see DMLC_NUM_ATTEMPT > 0 — the recorder then shows the reconnect
-        # wave this rank came back through.
+        # wave this rank came back through.  The seconds field closes the
+        # engine_init -> bootstrap_done span the trace exporter draws.
         self.obs_event(
             "bootstrap_done",
             rank=self.get_rank(),
             world=self.get_world_size(),
             attempt=int(os.environ.get("DMLC_NUM_ATTEMPT", "0") or "0"),
+            seconds=round(time.time() - t0, 6),
         )
 
     def shutdown(self) -> None:

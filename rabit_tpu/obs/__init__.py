@@ -55,6 +55,7 @@ from rabit_tpu.obs.metrics import (  # noqa: F401 (re-exports)
     _Span,
 )
 from rabit_tpu.obs import ship as _ship
+from rabit_tpu.obs.trace import GLOBAL_CLOCK  # noqa: F401 (re-export)
 
 #: Exit code of the hang-abort escalation (dump-then-die).  Distinct from
 #: the native recovery watchdog's exit 10 so launch logs tell the two
@@ -98,10 +99,30 @@ class _ObsState:
         self.sigterm_installed = False
         self.prev_sigterm = None
         # set by the watchdog when it declares this process hung; gates the
-        # one-shot dump AND withholds further lease renewals
+        # one-shot dump AND withholds further lease renewals.  Cleared (and
+        # a hang_recovered event recorded) when the declared op completes —
+        # a slow-but-successful collective must not permanently withhold
+        # renewals and get a healthy worker killed.
         self.hang_dumped = False
-        # thread-id -> (op, cache_key, t0_monotonic) of in-flight collectives
-        self.inflight: dict[int, tuple[str, str | None, float]] = {}
+        # (thread-id, t0, op) of the in-flight entry the declaration was
+        # made on, so recovery is detected even if another collective is
+        # already in flight by the next watchdog scan
+        self.hang_ref: tuple[int, float, str] | None = None
+        # thread-id -> (op, cache_key, t0_monotonic, version, seqno) of
+        # in-flight collectives
+        self.inflight: dict[int, tuple[str, str | None, float, int, int]] = {}
+        # dumps written by this process so far — the filename counter that
+        # keeps a second same-reason dump (hang, recover, hang again) from
+        # overwriting the first
+        self.dump_seq = 0
+        # cross-rank collective identity (trace.py): seqno resets on every
+        # checkpoint-version change, so a restarted worker resumes the
+        # numbering where the survivors' replay serves it
+        self.op_version = 0
+        self.op_seq = 0
+        # rabit_trace_* knobs (doc/observability.md "Cross-rank tracing")
+        self.trace_exit = False
+        self.trace_clock_pings = 2
 
 
 _STATE = _ObsState()
@@ -115,7 +136,8 @@ def configure(config, rank: int = -1) -> None:
     Keys (doc/observability.md, doc/fault_tolerance.md): ``rabit_obs_dir``
     (also the plain ``RABIT_OBS_DIR`` env var), ``rabit_obs_capacity``,
     ``rabit_obs_hang_sec``, ``rabit_obs_heartbeat_sec``,
-    ``rabit_hang_abort_sec``, ``rabit_heartbeat_sec``.
+    ``rabit_hang_abort_sec``, ``rabit_heartbeat_sec``,
+    ``rabit_trace_exit``, ``rabit_trace_clock_pings``.
     """
     obs_dir = (config.get("rabit_obs_dir", "") or
                os.environ.get("RABIT_OBS_DIR", "") or "")
@@ -129,6 +151,10 @@ def configure(config, rank: int = -1) -> None:
     tracker_uri = config.get("rabit_tracker_uri", "NULL")
     task_id = config.get("rabit_task_id", "NULL") or "NULL"
 
+    trace_exit = (config.get("rabit_trace_exit", "0") or "0") not in (
+        "0", "", "false", "no")
+    clock_pings = config.get_int("rabit_trace_clock_pings", 2)
+
     GLOBAL_RECORDER.set_capacity(capacity)
     with _STATE.lock:
         _STATE.obs_dir = obs_dir
@@ -137,11 +163,20 @@ def configure(config, rank: int = -1) -> None:
         _STATE.heartbeat_sec = lease_sec
         _STATE.rank = rank
         _STATE.task_id = task_id
+        _STATE.trace_exit = trace_exit
+        _STATE.trace_clock_pings = clock_pings
+        # fresh init: the cross-rank collective numbering restarts at
+        # (version 0, seq 0), exactly like every other first-life rank's
+        _STATE.op_version = 0
+        _STATE.op_seq = 0
         _STATE.tracker = None
         if tracker_uri and tracker_uri != "NULL":
             _STATE.tracker = (
                 tracker_uri, config.get_int("rabit_tracker_port", 9091)
             )
+    # A re-init may point at a different tracker; offset samples against
+    # the old one are meaningless on the new timeline.
+    GLOBAL_CLOCK.reset()
     if obs_dir:
         os.makedirs(obs_dir, exist_ok=True)
         _install_sigterm_dump()
@@ -166,17 +201,41 @@ def configure(config, rank: int = -1) -> None:
 
 # -- collective spans --------------------------------------------------------
 
+def collective_epoch(version: int) -> None:
+    """Note a checkpoint-version change (commit or recovery load) in the
+    cross-rank collective numbering: the per-version seqno resets, so the
+    same logical collective carries the same ``(version, seqno)`` on every
+    rank — including a restarted worker, whose load_checkpoint lands it on
+    exactly the version the survivors' numbering restarted at (trace.py
+    merges dumps on this identity)."""
+    with _STATE.lock:
+        if version != _STATE.op_version:
+            _STATE.op_version = int(version)
+            _STATE.op_seq = 0
+
+
+def collective_seq() -> tuple[int, int]:
+    """The (version, next-seqno) the next collective will be stamped with."""
+    with _STATE.lock:
+        return _STATE.op_version, _STATE.op_seq
+
+
 @contextlib.contextmanager
 def collective(op: str, nbytes: int, cache_key: str | None = None):
     """The one timing/eventing path for every public collective: records
-    ``op_begin``/``op_end`` events, marks the thread in-flight for the hang
+    ``op_begin``/``op_end`` events stamped with the cross-rank
+    ``(version, seqno)`` identity, marks the thread in-flight for the hang
     watchdog, and times into the registry's per-op stats + latency
     histogram.  Yields a span whose ``nbytes`` may be updated inside the
     window (object broadcast learns its length from the wire)."""
     tid = threading.get_ident()
-    record_event("op_begin", op=op, nbytes=nbytes, cache_key=cache_key)
     with _STATE.lock:
-        _STATE.inflight[tid] = (op, cache_key, time.monotonic())
+        version, seqno = _STATE.op_version, _STATE.op_seq
+        _STATE.op_seq += 1
+        _STATE.inflight[tid] = (op, cache_key, time.monotonic(), version,
+                                seqno)
+    record_event("op_begin", op=op, nbytes=nbytes, cache_key=cache_key,
+                 version=version, seqno=seqno)
     t0 = time.perf_counter()
     span = _Span(op, nbytes, cache_key)
     try:
@@ -187,28 +246,38 @@ def collective(op: str, nbytes: int, cache_key: str | None = None):
             _STATE.inflight.pop(tid, None)
         GLOBAL_REGISTRY.observe_op(op, span.nbytes, dt)
         record_event("op_end", op=op, nbytes=span.nbytes,
-                     cache_key=cache_key, seconds=round(dt, 6))
+                     cache_key=cache_key, seconds=round(dt, 6),
+                     version=version, seqno=seqno)
 
 
 # -- failure-path dumps ------------------------------------------------------
 
 def dump_now(reason: str) -> str | None:
     """Dump the flight recorder to the configured obs dir; returns the path
-    (None when no dir is configured).  Never raises."""
+    (None when no dir is configured).  Never raises.
+
+    The filename carries a per-process dump counter (``-n<seq>-``) so the
+    same reason firing twice in one life (hang, recover, hang again) writes
+    two files instead of overwriting the first's evidence."""
     with _STATE.lock:
         obs_dir, rank = _STATE.obs_dir, _STATE.rank
         inflight = list(_STATE.inflight.values())
     if not obs_dir:
         return None
     try:
-        for op, key, t0 in inflight:
+        for op, key, t0, version, seqno in inflight:
             record_event("op_inflight", op=op, cache_key=key,
-                         stuck_seconds=round(time.monotonic() - t0, 3))
+                         stuck_seconds=round(time.monotonic() - t0, 3),
+                         version=version, seqno=seqno)
+        with _STATE.lock:
+            _STATE.dump_seq += 1
+            seq = _STATE.dump_seq
         path = os.path.join(
-            obs_dir, f"flight-rank{rank}-pid{os.getpid()}-{reason}.jsonl"
+            obs_dir,
+            f"flight-rank{rank}-pid{os.getpid()}-n{seq}-{reason}.jsonl",
         )
         return GLOBAL_RECORDER.dump(
-            path, header={"reason": reason, "rank": rank,
+            path, header={"reason": reason, "rank": rank, "dump_seq": seq,
                           "task_id": _STATE.task_id}
         )
     except OSError:
@@ -242,15 +311,32 @@ def _install_sigterm_dump() -> None:
 
 def _watchdog_loop() -> None:
     while True:
+        recovered: tuple[str, float] | None = None
         with _STATE.lock:
             hang_sec = _STATE.hang_sec
             abort_sec = _STATE.hang_abort_sec
             declared = _STATE.hang_dumped
             now = time.monotonic()
-            worst: tuple[str, str | None, float] | None = None
-            for op, key, t0 in _STATE.inflight.values():
+            worst: tuple[str, str | None, float, int, float] | None = None
+            for tid, (op, key, t0, _v, _s) in _STATE.inflight.items():
                 if worst is None or now - t0 > worst[2]:
-                    worst = (op, key, now - t0)
+                    worst = (op, key, now - t0, tid, t0)
+            if declared and _STATE.hang_ref is not None:
+                # Latch release: the op the declaration was made on is no
+                # longer in flight — the "hang" was slow-but-successful.
+                # Clear the latch so lease renewals resume (a permanently
+                # withheld lease would get this healthy worker killed) and
+                # the one-shot dump re-arms for a future real hang.
+                ref_tid, ref_t0, ref_op = _STATE.hang_ref
+                cur = _STATE.inflight.get(ref_tid)
+                if cur is None or cur[2] != ref_t0:
+                    _STATE.hang_dumped = False
+                    _STATE.hang_ref = None
+                    declared = False
+                    recovered = (ref_op, now - ref_t0)
+        if recovered is not None:
+            record_event("hang_recovered", op=recovered[0],
+                         stuck_seconds=round(recovered[1], 3))
         # Detection threshold: rabit_obs_hang_sec when set, else the abort
         # bound alone drives it (abort without a separate dump threshold).
         detect_sec = hang_sec if hang_sec > 0 else abort_sec
@@ -261,6 +347,7 @@ def _watchdog_loop() -> None:
             dump_now("hang")  # no-op without an obs dir
             with _STATE.lock:
                 _STATE.hang_dumped = True
+                _STATE.hang_ref = (worst[3], worst[4], worst[0])
             declared = True
         if worst is not None and abort_sec > 0 and worst[2] > abort_sec:
             # Dump-then-die: evidence is already on disk (the declaration
@@ -295,10 +382,12 @@ def _start_hang_watchdog() -> None:
 def _make_snapshot() -> dict:
     with _STATE.lock:
         rank, task_id = _STATE.rank, _STATE.task_id
-    return _ship.build_snapshot(
-        GLOBAL_REGISTRY, rank, task_id,
-        extra={"flight_dropped": GLOBAL_RECORDER.dropped},
-    )
+    extra: dict = {"flight_dropped": GLOBAL_RECORDER.dropped}
+    clock = GLOBAL_CLOCK.snapshot()
+    if clock is not None:
+        # this rank's tracker-clock offset estimate (trace.py projection)
+        extra["clock"] = clock
+    return _ship.build_snapshot(GLOBAL_REGISTRY, rank, task_id, extra=extra)
 
 
 def _ship_metrics_snapshot() -> bool:
@@ -347,7 +436,24 @@ def ship_final_snapshot() -> bool:
     stop_heartbeat()
     with _STATE.lock:
         tracker, task_id = _STATE.tracker, _STATE.task_id
+        pings = _STATE.trace_clock_pings
     if tracker is None:
         return False
+    # Tighten (or bootstrap — a job that never enabled heartbeats has no
+    # samples yet) the clock estimate before it is frozen into the final
+    # snapshot: each ping is one timestamped round-trip, no lease effect.
+    if pings > 0:
+        _ship.clock_ping(tracker[0], tracker[1], task_id, samples=pings)
     return _ship.ship_snapshot(_make_snapshot(), tracker[0], tracker[1],
                                task_id)
+
+
+def dump_final() -> str | None:
+    """With ``rabit_trace_exit=1``, write this life's flight ring as a
+    ``-exit`` dump at finalize, so a CLEAN run leaves the per-rank evidence
+    the cross-rank trace merger joins (hangs/SIGTERMs already dump; clean
+    exits previously left nothing).  Called by ``rabit_tpu.finalize`` after
+    the engine shutdown handshake."""
+    with _STATE.lock:
+        want = _STATE.trace_exit and bool(_STATE.obs_dir)
+    return dump_now("exit") if want else None

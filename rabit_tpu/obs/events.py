@@ -20,6 +20,7 @@ from __future__ import annotations
 import io
 import json
 import os
+import re
 import threading
 import time
 from collections import deque
@@ -162,19 +163,33 @@ def _line_rank(line: str) -> int:
 
 
 def event_from_stats_line(line: str, ts: float | None = None) -> Event | None:
-    """Convert one robust-engine observability print into a structured
-    event, or None for ordinary prints.  Numeric fields are parsed to
-    int/float; the emitting rank comes from the ``[N]`` prefix."""
+    """Convert one worker observability print into a structured event, or
+    None for ordinary prints.  Numeric fields are parsed to int/float; the
+    emitting rank comes from the ``[N]`` prefix.
+
+    Recognized: the robust engine's ``recover_stats`` /
+    ``recover_stats_final`` / ``failure_detected`` lines, plus the recovery
+    workloads' ``recovered_at=`` (in-job peer recovery complete) and
+    ``resumed from disk`` (durable whole-job resume) stamps — so tools read
+    ``LocalCluster.events`` / ``telemetry.json`` instead of scraping
+    stdout."""
     if "recover_stats_final" in line:
         kind = "recover_stats_final"
     elif "recover_stats " in line:
         kind = "recover_stats"
     elif "failure_detected" in line:
         kind = "failure_detected"
+    elif "recovered_at=" in line:
+        kind = "worker_recovered"
+    elif "resumed from disk" in line:
+        kind = "disk_resume"
     else:
         return None
     fields: dict = {"rank": _line_rank(line)}
     for key, raw in parse_stats_line(line).items():
+        if key in _RESERVED:
+            # a printed ts= stamp must not shadow the envelope's ts
+            key = "at"
         try:
             fields[key] = int(raw)
         except ValueError:
@@ -182,6 +197,10 @@ def event_from_stats_line(line: str, ts: float | None = None) -> Event | None:
                 fields[key] = float(raw)
             except ValueError:
                 fields[key] = raw
+    if kind == "disk_resume" and "version" not in fields:
+        m = re.search(r"at version (\d+)", line)
+        if m:
+            fields["version"] = int(m.group(1))
     return Event(time.time() if ts is None else ts, kind, fields)
 
 

@@ -30,6 +30,15 @@ from rabit_tpu.tracker import protocol as P
 SNAPSHOT_SCHEMA = 1
 
 
+def _note_clock(reply: object) -> None:
+    """Fold a timestamped ACK into the process clock estimate (lazy import:
+    this module is imported by the obs package __init__, trace is not)."""
+    if isinstance(reply, P.TimedAck):
+        from rabit_tpu.obs.trace import GLOBAL_CLOCK
+
+        GLOBAL_CLOCK.update(reply.offset, reply.err)
+
+
 def build_snapshot(registry, rank: int, task_id: str, host: str = "",
                    extra: dict | None = None) -> dict:
     """The JSON envelope a worker ships: identity + full registry state."""
@@ -49,12 +58,14 @@ def ship_snapshot(snapshot: dict, tracker_host: str, tracker_port: int,
                   task_id: str, timeout: float = 5.0, retries: int = 0) -> bool:
     """Send one snapshot; True on ACK.  Raises nothing."""
     try:
-        return P.tracker_rpc(
+        reply = P.tracker_rpc(
             tracker_host, tracker_port, P.CMD_METRICS, task_id,
             message=json.dumps(snapshot), timeout=timeout, retries=retries,
-        ) == P.ACK
+        )
     except (P.TrackerUnreachable, ValueError):
         return False
+    _note_clock(reply)
+    return reply == P.ACK
 
 
 def renew_lease(tracker_host: str, tracker_port: int, task_id: str,
@@ -67,14 +78,38 @@ def renew_lease(tracker_host: str, tracker_port: int, task_id: str,
     (``LEASE_FACTOR``).  The send is bounded by ``timeout`` (default: one
     interval) so a wedged tracker cannot back the sender up."""
     try:
-        return P.tracker_rpc(
+        reply = P.tracker_rpc(
             tracker_host, tracker_port, P.CMD_HEARTBEAT, task_id,
             prev_rank=rank, message=repr(float(interval)),
             timeout=timeout if timeout is not None else max(interval, 0.2),
             retries=0,
-        ) == P.ACK
+        )
     except (P.TrackerUnreachable, ValueError):
         return False
+    _note_clock(reply)
+    return reply == P.ACK
+
+
+def clock_ping(tracker_host: str, tracker_port: int, task_id: str,
+               samples: int = 2, timeout: float = 2.0) -> int:
+    """Collect clock-offset samples without any other effect: a heartbeat
+    with interval 0 grants no lease (the tracker ignores non-positive
+    intervals) but its reply still carries the tracker clock stamp.  Used
+    at shutdown so even a job that never enabled periodic heartbeats ships
+    a clock estimate in its final snapshot.  Returns how many samples
+    landed; raises nothing."""
+    got = 0
+    for _ in range(max(samples, 0)):
+        try:
+            reply = P.tracker_rpc(
+                tracker_host, tracker_port, P.CMD_HEARTBEAT, task_id,
+                message="0", timeout=timeout, retries=0,
+            )
+        except (P.TrackerUnreachable, ValueError):
+            return got
+        _note_clock(reply)
+        got += 1
+    return got
 
 
 class Heartbeat:

@@ -38,6 +38,14 @@ workers is complete):
 
 tracker -> worker (print/shutdown reply): u32 ACK
 
+tracker -> worker (metrics/heartbeat reply): u32 ACK, str server_ts — the
+    tracker's ``time.time()`` stamped while answering.  The worker brackets
+    the RPC and takes the NTP-style midpoint: ``offset = server_ts -
+    (t_send + t_recv)/2`` with error bound rtt/2 — the clock-alignment
+    signal rabit_tpu.obs.trace projects per-rank timelines with.  Only the
+    two Python-side commands carry the stamp; the native C++ client speaks
+    only start/recover/print/shutdown, whose replies are unchanged.
+
 worker <-> worker link handshake (both directions on connect/accept):
     u32 MAGIC_LINK, i32 my_rank, u32 epoch
 """
@@ -182,6 +190,39 @@ def send_hello(
     send_all(sock, b"".join(out))
 
 
+class TimedAck(int):
+    """An ACK that carries the tracker's clock stamp (metrics/heartbeat
+    replies).  Compares equal to the plain u32 ACK value, so existing
+    ``reply == ACK`` callers are unaffected; ``offset``/``err`` expose the
+    NTP-style midpoint estimate of tracker_clock - worker_clock."""
+
+    server_ts: float
+    t_send: float
+    t_recv: float
+
+    def __new__(cls, ack: int, server_ts: float, t_send: float,
+                t_recv: float) -> "TimedAck":
+        self = super().__new__(cls, ack)
+        self.server_ts = server_ts
+        self.t_send = t_send
+        self.t_recv = t_recv
+        return self
+
+    @property
+    def rtt(self) -> float:
+        return max(self.t_recv - self.t_send, 0.0)
+
+    @property
+    def offset(self) -> float:
+        """tracker_ts - worker_ts; project with worker_ts + offset."""
+        return self.server_ts - (self.t_send + self.t_recv) / 2.0
+
+    @property
+    def err(self) -> float:
+        """Half the round trip — the offset estimate's error bound."""
+        return self.rtt / 2.0
+
+
 class TrackerUnreachable(ConnectionError):
     """The tracker could not be reached (or never replied) within the retry
     budget.  Raised by :func:`tracker_rpc` so callers can fail fast with a
@@ -220,8 +261,10 @@ def tracker_rpc(
     error surfaces as :class:`TrackerUnreachable`.
 
     Returns the :class:`Assignment` for START/RECOVER, the u32 ACK value
-    otherwise.  Retrying START/RECOVER is safe: the tracker replaces a task
-    id's stale pending entry on re-check-in (Tracker._register).
+    otherwise — as a :class:`TimedAck` (ACK plus the tracker's clock stamp
+    and the local send/recv bracket) for METRICS/HEARTBEAT.  Retrying
+    START/RECOVER is safe: the tracker replaces a task id's stale pending
+    entry on re-check-in (Tracker._register).
     """
     rng = rng if rng is not None else random
     retries = max(int(retries), 0)
@@ -230,13 +273,20 @@ def tracker_rpc(
         try:
             with socket.create_connection((host, int(port)), timeout=timeout) as sock:
                 sock.settimeout(timeout)
+                t_send = time.time()
                 send_hello(sock, cmd, task_id, prev_rank=prev_rank,
                            listen_port=listen_port, message=message)
                 if cmd in (CMD_START, CMD_RECOVER):
                     sock.settimeout(reply_timeout if reply_timeout is not None
                                     else timeout)
                     return Assignment.recv(sock)
-                return get_u32(sock)
+                ack = get_u32(sock)
+                if cmd in (CMD_METRICS, CMD_HEARTBEAT):
+                    # timestamped reply (see module docstring): the stamp
+                    # plus the local send/recv bracket is one clock sample
+                    server_ts = float(get_str(sock))
+                    return TimedAck(ack, server_ts, t_send, time.time())
+                return ack
         except (ConnectionError, OSError) as exc:  # socket.timeout is OSError
             last_err = exc
             if attempt < retries:

@@ -298,11 +298,11 @@ class Tracker:
             elif cmd == P.CMD_METRICS:
                 msg = P.get_str(conn)
                 self._accept_snapshot(msg)
-                conn.sendall(P.put_u32(P.ACK))
+                conn.sendall(P.put_u32(P.ACK) + self._clock_stamp())
             elif cmd == P.CMD_HEARTBEAT:
                 msg = P.get_str(conn)
                 self._renew_lease(task_id, prev_rank, msg)
-                conn.sendall(P.put_u32(P.ACK))
+                conn.sendall(P.put_u32(P.ACK) + self._clock_stamp())
             elif cmd == P.CMD_SHUTDOWN:
                 with self._lock:
                     # A clean exit must not be suspected afterwards; drop
@@ -325,6 +325,14 @@ class Tracker:
                 conn.close()
             except OSError:
                 pass
+
+    @staticmethod
+    def _clock_stamp() -> bytes:
+        """The tracker's clock, appended to metrics/heartbeat ACKs — one
+        half of the NTP-style offset estimate (protocol.TimedAck).  The
+        tracker clock is the job's reference timeline: every worker's
+        events are projected onto it by rabit_tpu.obs.trace."""
+        return P.put_str(f"{time.time():.6f}")
 
     def _register(self, conn, host, task_id, listen_port, prev_rank,
                   cmd=P.CMD_START) -> None:
@@ -453,6 +461,11 @@ class Tracker:
             snapshots = {str(r): s for r, s in sorted(self.snapshots.items())}
             restarts = {t: n - 1 for t, n in self._n_starts.items() if n > 1}
         waves = [e for e in events if e["kind"] == "wave"]
+        # Per-rank clock-offset estimates (tracker_ts = worker_ts +
+        # offset_s), shipped inside snapshots; the trace merger uses these
+        # to project every rank's dump onto the tracker timeline.
+        clocks = {r: s["clock"] for r, s in snapshots.items()
+                  if isinstance(s, dict) and s.get("clock")}
         return {
             "schema": TELEMETRY_SCHEMA,
             "world_size": self.world_size,
@@ -463,6 +476,7 @@ class Tracker:
             "n_lease_expired": sum(1 for e in events
                                    if e["kind"] == "lease_expired"),
             "restarts": restarts,
+            "clocks": clocks,
             "waves": waves,
             "events": events,
             "ranks": snapshots,

@@ -5,9 +5,10 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 # rabit_tpu covers its subpackages (engine/, tracker/, parallel/, models/,
-# ops/, obs/); the explicit obs/ and chaos.py entries guard against those
-# pieces being moved out of the tree without their checks following.
-python -m compileall -q rabit_tpu rabit_tpu/obs rabit_tpu/chaos.py tests guide tools bench.py __graft_entry__.py
+# ops/, obs/); the explicit obs/, trace, chaos and tool entries guard
+# against those pieces being moved out of the tree without their checks
+# following.
+python -m compileall -q rabit_tpu rabit_tpu/obs rabit_tpu/obs/trace.py rabit_tpu/chaos.py tests guide tools tools/trace_tool.py bench.py __graft_entry__.py
 make -C native clean > /dev/null
 make -C native CXXFLAGS="-O2 -std=c++17 -fPIC -Wall -Wextra -Wno-unused-parameter -Werror" > /dev/null
 echo "lint OK"

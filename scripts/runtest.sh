@@ -22,7 +22,16 @@ make -C native test
 # "$@" overrides, so `scripts/runtest.sh -m slow` runs the long suite.
 python -m pytest tests/ -q -m "not slow" "$@"
 
-hang_dumps=$(find "$RABIT_OBS_DIR" -name 'flight-*.jsonl' 2>/dev/null || true)
+# Cross-rank trace gate (doc/observability.md "Cross-rank tracing"):
+# merge whatever the suite's e2e runs left in the obs dir (flight dumps,
+# telemetry.json) into one Perfetto trace.  A merge or schema-validation
+# error fails the suite, so every tier-1 run exercises the export path.
+python tools/trace_tool.py export "$RABIT_OBS_DIR" -o "$RABIT_OBS_DIR/trace.json"
+echo "trace gate OK (merged $RABIT_OBS_DIR into trace.json)"
+
+# Failure dumps are FATAL; -exit dumps (rabit_trace_exit=1 clean-run trace
+# evidence) are expected artifacts and excluded.
+hang_dumps=$(find "$RABIT_OBS_DIR" -name 'flight-*.jsonl' ! -name '*-exit.jsonl' 2>/dev/null || true)
 if [ -n "$hang_dumps" ]; then
     echo "FATAL: flight-recorder hang dumps were written during the suite:" >&2
     echo "$hang_dumps" >&2

@@ -20,6 +20,11 @@ Worker args (k=v on the command line, all also forwarded to the engine):
                    machine-independent minimum duration so timed external
                    preemptions (tests/test_preemption.py) reliably land
                    mid-work on hosts of any speed
+    straggler=R    rank R additionally sleeps straggler_sleep seconds
+                   (default 0.25) before each iteration's first collective
+                   — a deterministic injected straggler whose arrival skew
+                   the cross-rank trace analytics must attribute to R
+                   (tools/trace_tool.py report, tests/test_trace.py)
     blob_mb=F      carry an F-MiB byte blob inside the global model, with
                    closed-form content per version so a recovered blob is
                    verified byte-for-byte — sizes the checkpoint-serve path
@@ -65,6 +70,8 @@ def main() -> int:
     niter = int(getarg("niter", "3"))
     blob_mb = float(getarg("blob_mb", "0"))
     pause = float(getarg("sleep", "0"))
+    straggler = int(getarg("straggler", "-1"))
+    straggler_sleep = float(getarg("straggler_sleep", "0.25"))
 
     def blob_for(ver: int) -> bytes:
         # Deterministic per-version content: recovery must reproduce the
@@ -132,6 +139,11 @@ def main() -> int:
     for it in range(version, niter):
         if pause:
             time.sleep(pause)
+        if rank == straggler:
+            # Injected straggler: everyone else reaches the MAX allreduce
+            # and waits here — the arrival-skew signature trace analytics
+            # must pin on this rank.
+            time.sleep(straggler_sleep)
         # MAX: data[i] = rank + i + it  ->  world-1 + i + it
         a = (np.arange(ndata) + rank + it).astype(np.float32)
         out = rt.allreduce(a, rt.MAX)

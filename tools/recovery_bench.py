@@ -68,16 +68,17 @@ def run_once(world: int, extra: list[str], timeout: float | None = None,
     dt = time.perf_counter() - t0
     if rc != 0 or any(r != 0 for r in cluster.returncodes):
         raise RuntimeError(f"cluster failed: rc={rc} {cluster.returncodes}")
-    resume_stamps = [float(m.split("ts=")[1].split()[0])
-                     for m in cluster.messages
-                     if "resumed from disk" in m and "ts=" in m]
+    # Structured events throughout (the stdout-scraping this tool used to
+    # do is what rabit_tpu.profile's deprecated parsers served): the
+    # tracker converts the workers' recovered_at / resumed-from-disk
+    # stamps into worker_recovered / disk_resume events at CMD_PRINT
+    # ingest (rabit_tpu.obs.events.event_from_stats_line).
+    resume_stamps = [ev["at"] for ev in cluster.events
+                     if ev["kind"] == "disk_resume" and "at" in ev]
     resume_latency = (max(resume_stamps) - t0w) if resume_stamps else None
     latency = None
-    stamps = [
-        float(m.split("recovered_at=")[1].split()[0])
-        for m in cluster.messages
-        if "recovered_at=" in m
-    ]
+    stamps = [ev["recovered_at"] for ev in cluster.events
+              if ev["kind"] == "worker_recovered" and "recovered_at" in ev]
     if stamps and cluster.death_times:
         latency = min(stamps) - cluster.death_times[0]
     # Kill -> first survivor notices (EOF cascade / stall timeout), the

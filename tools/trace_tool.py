@@ -1,0 +1,134 @@
+"""Cross-rank trace tool — merge, export, and analyze a job's obs dir.
+
+Joins the per-rank ``flight-*.jsonl`` dumps and the tracker's
+``telemetry.json`` under one ``RABIT_OBS_DIR`` into a single job-wide
+timeline (rabit_tpu/obs/trace.py; doc/observability.md "Cross-rank
+tracing").  Capture a traceable run with ``rabit_trace_exit=1`` so clean
+ranks dump at finalize, then:
+
+  python tools/trace_tool.py export  OBS_DIR [-o trace.json] [--no-fold]
+      merge everything into Chrome/Perfetto trace_event JSON (open the
+      file in https://ui.perfetto.dev), self-validating; also folds the
+      straggler aggregates back into telemetry.json unless --no-fold.
+
+  python tools/trace_tool.py report  OBS_DIR [--top K] [--json]
+      per-seqno arrival-skew analytics: top-K stragglers by cumulative
+      lateness, worst collectives by first-enter vs last-enter skew,
+      recovery-affected collectives tallied separately.
+
+  python tools/trace_tool.py validate TRACE_JSON
+      structural check of an exported trace against the trace_event
+      schema subset this exporter emits.
+
+Exit status is nonzero on merge/validation errors (the CI gate in
+scripts/runtest.sh runs ``export`` over the suite's obs dir).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+from rabit_tpu.obs import trace  # noqa: E402
+
+
+def cmd_export(args: argparse.Namespace) -> int:
+    doc, path, report = trace.export_job(
+        args.obs_dir, out_path=args.out, fold=not args.no_fold,
+        top_k=args.top)
+    n_spans = sum(1 for e in doc["traceEvents"] if e.get("ph") == "X")
+    other = doc["otherData"]
+    print(json.dumps({
+        "trace": path,
+        "ranks": other["ranks"],
+        "dumps_merged": other["dumps_merged"],
+        "spans": n_spans,
+        "events": len(doc["traceEvents"]),
+        "collectives_analyzed": report["collectives_analyzed"],
+        "clock_max_err_s": other["clock_max_err_s"],
+    }))
+    return 0
+
+
+def cmd_report(args: argparse.Namespace) -> int:
+    job = trace.load_job(args.obs_dir)
+    report = trace.straggler_report(job, top_k=args.top)
+    if args.write_telemetry:
+        trace.fold_into_telemetry(args.obs_dir, report)
+    if args.json:
+        print(json.dumps(report, indent=1, sort_keys=True))
+        return 0
+    print(f"collectives: {report['collectives_analyzed']} analyzed, "
+          f"{report['collectives_recovery_affected']} recovery-affected, "
+          f"{report['collectives_total']} total "
+          f"(clock err <= {report['clock_max_err_s']*1e3:.3f} ms)")
+    print("top stragglers (by cumulative arrival lateness):")
+    for i, s in enumerate(report["top_stragglers"], 1):
+        print(f"  #{i} rank {s['rank']}: "
+              f"late {s['lateness_total_s']*1e3:.3f} ms total "
+              f"({s['lateness_share']*100:.1f}% of job lateness), "
+              f"last-arriver in {s['last_arriver_count']}/{s['arrivals']} "
+              f"collectives, made peers wait {s['wait_total_s']*1e3:.3f} ms")
+    print("worst collectives (by first-enter vs last-enter skew):")
+    for w in report["worst_skews"]:
+        print(f"  {w['op']} v{w['version']}.{w['seqno']}: "
+              f"skew {w['skew_s']*1e3:.3f} ms, last rank {w['last_rank']}")
+    return 0
+
+
+def cmd_validate(args: argparse.Namespace) -> int:
+    with open(args.trace_json) as f:
+        doc = json.load(f)
+    errs = trace.validate_chrome_trace(doc)
+    if errs:
+        for e in errs[:20]:
+            print(f"INVALID: {e}", file=sys.stderr)
+        return 1
+    print(f"OK: {len(doc['traceEvents'])} events validate")
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="merge per-rank flight dumps + telemetry.json into one "
+                    "Perfetto trace and straggler report")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    exp = sub.add_parser("export", help="write Chrome/Perfetto trace JSON")
+    exp.add_argument("obs_dir")
+    exp.add_argument("-o", "--out", default=None,
+                     help="output path (default: OBS_DIR/trace.json)")
+    exp.add_argument("--top", type=int, default=3)
+    exp.add_argument("--no-fold", action="store_true",
+                     help="do not fold straggler aggregates into "
+                          "telemetry.json")
+    exp.set_defaults(fn=cmd_export)
+
+    rep = sub.add_parser("report", help="straggler analytics")
+    rep.add_argument("obs_dir")
+    rep.add_argument("--top", type=int, default=3)
+    rep.add_argument("--json", action="store_true")
+    rep.add_argument("--write-telemetry", action="store_true",
+                     help="fold the report into telemetry.json")
+    rep.set_defaults(fn=cmd_report)
+
+    val = sub.add_parser("validate", help="validate an exported trace")
+    val.add_argument("trace_json")
+    val.set_defaults(fn=cmd_validate)
+
+    args = ap.parse_args(argv)
+    try:
+        return args.fn(args)
+    except trace.TraceError as exc:
+        print(f"trace merge failed: {exc}", file=sys.stderr)
+        return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())
