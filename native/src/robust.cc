@@ -30,6 +30,7 @@
 #include <unistd.h>
 
 #include <algorithm>
+#include <chrono>
 #include <condition_variable>
 #include <cstring>
 #include <functional>
@@ -131,8 +132,18 @@ class Watchdog {
     cancel_ = false;
     thread_ = std::thread([this, sec, rank] {
       std::unique_lock<std::mutex> lk(m_);
-      if (!cv_.wait_for(lk, std::chrono::duration<double>(sec),
-                        [this] { return cancel_; })) {
+      // system_clock deadline rather than wait_for: libstdc++ lowers
+      // wait_for onto pthread_cond_clockwait (steady clock), which the
+      // gcc-10 TSan runtime does not intercept — every CORRECT wait then
+      // reports a bogus "double lock of a mutex" (verified with a minimal
+      // repro; doc/static_analysis.md "Sanitizer targets").  The
+      // pthread_cond_timedwait path below is intercepted.  A wall-clock
+      // step during the wait skews the bound by the step size — fine for
+      // a coarse seconds-scale watchdog.
+      auto deadline = std::chrono::system_clock::now() +
+          std::chrono::duration_cast<std::chrono::system_clock::duration>(
+              std::chrono::duration<double>(sec));
+      if (!cv_.wait_until(lk, deadline, [this] { return cancel_; })) {
         fprintf(stderr,
                 "[rank %d] fatal: recovery did not complete within %.0fs "
                 "(rabit_timeout_sec); aborting\n",

@@ -46,6 +46,13 @@ _UNIT = {"B": 1, "K": 1 << 10, "M": 1 << 20, "G": 1 << 30}
 #: semantics and defaults.
 DEFAULTS: dict[str, str] = {
     "rabit_engine": "auto",           # auto | empty | xla | native | mock
+    # XLA engine multi-process bootstrap (engine/xla.py): empty means
+    # "fall back to the standard JAX cluster env vars" (the engine reads
+    # these with an `or` chain, so the empty default never shadows
+    # JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES / JAX_PROCESS_ID).
+    "rabit_xla_coordinator": "",
+    "rabit_xla_num_processes": "",
+    "rabit_xla_process_id": "",
     "rabit_tracker_uri": "NULL",
     "rabit_tracker_port": "9091",
     "rabit_task_id": "NULL",

@@ -48,17 +48,17 @@ class XlaEngine(Engine):
         # launcher).  Config keys override env so a launcher can pass them
         # as argv k=v pairs.  Must run before any other jax call touches
         # the backend.
-        coord = self.config.get(
-            "rabit_xla_coordinator", os.environ.get("JAX_COORDINATOR_ADDRESS", "")
-        )
+        # `or` fallback (not a .get default): the keys are declared in
+        # config.DEFAULTS with empty sentinels, so a plain default arg
+        # would never fire and the env vars would be shadowed.
+        coord = (self.config.get("rabit_xla_coordinator", "")
+                 or os.environ.get("JAX_COORDINATOR_ADDRESS", ""))
         nproc = int(
-            self.config.get(
-                "rabit_xla_num_processes", os.environ.get("JAX_NUM_PROCESSES", "0") or "0"
-            )
+            self.config.get("rabit_xla_num_processes", "")
+            or os.environ.get("JAX_NUM_PROCESSES", "0") or "0"
         )
-        pid = self.config.get(
-            "rabit_xla_process_id", os.environ.get("JAX_PROCESS_ID", "")
-        )
+        pid = (self.config.get("rabit_xla_process_id", "")
+               or os.environ.get("JAX_PROCESS_ID", ""))
         any_set = bool(coord) or nproc > 0 or pid != ""
         all_set = bool(coord) and nproc > 0 and pid != ""
         if any_set and not all_set:

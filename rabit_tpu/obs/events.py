@@ -33,6 +33,54 @@ DEFAULT_CAPACITY = 2048
 #: Keys reserved by the envelope — event fields must not collide.
 _RESERVED = ("ts", "kind")
 
+#: The declared event-kind registry — every ``kind`` string this stack
+#: emits, with the producer/meaning in one line.  The obs pipeline is
+#: stringly typed end to end (producers here and in api/engine/tracker;
+#: consumers in trace.py, telemetry aggregation, tools/, tests), so a
+#: typo or a one-sided rename fails silently: the event is recorded but
+#: no consumer ever matches it, and the Perfetto timeline or telemetry
+#: tally quietly loses that signal.  ``tools/tpulint`` statically checks
+#: all three directions against this dict (emitted => registered,
+#: consumed => registered AND emitted, registered => emitted); add the
+#: entry HERE in the same change that adds a producer or consumer.
+KINDS: dict[str, str] = {
+    # envelope / ring
+    "flight_dump": "dump header line: pid, rank, reason, n_events, dropped",
+    # collective spans (obs.collective; paired into trace spans)
+    "op_begin": "collective entered: op, nbytes, cache_key, version, seqno",
+    "op_end": "collective completed: adds seconds; pairs with op_begin",
+    "op_inflight": "dump-time marker: op stuck in flight, stuck_seconds",
+    # engine lifecycle (api.py / engine bridge)
+    "engine_ready": "init() complete: engine class, rank, world",
+    "engine_init": "native bridge entering RabitInit",
+    "bootstrap_done": "(re)bootstrap complete: rank, world, attempt, seconds",
+    "engine_shutdown": "native bridge entering RabitFinalize",
+    "engine_finalize": "rabit_tpu.finalize() reached (pre-shutdown)",
+    "engine_error": "native call failed: what, error (pre-exception)",
+    "init_after_exception": "robust re-init after a caught exception",
+    # checkpoint line (api.py / native bridge)
+    "checkpoint_commit": "version bump committed: version, nbytes",
+    "checkpoint_loaded": "bridge served a peer-recovered blob: version",
+    "load_checkpoint": "api load_checkpoint returned: version, recovered",
+    "version_bump": "native checkpoint committed: version",
+    # hang watchdog (obs.__init__)
+    "hang_detected": "collective stuck past rabit_obs_hang_sec",
+    "hang_recovered": "declared-hung op completed; lease renewals resume",
+    "hang_abort": "dump-then-die escalation firing (exit 11)",
+    # stats-line bridge (event_from_stats_line) + tracker-side events
+    "recover_stats": "robust engine per-recovery counters (from prints)",
+    "recover_stats_final": "robust engine shutdown-time counters",
+    "failure_detected": "robust engine noticed a dead peer: at=",
+    "worker_recovered": "workload's recovered_at= stamp (in-job recovery)",
+    "disk_resume": "workload resumed from durable spill: version",
+    # tracker telemetry (tracker.py)
+    "wave": "bootstrap/recovery wave assigned: epoch, assignments",
+    "wave_purged": "dead pending connections dropped at wave fill",
+    "lease_expired": "heartbeat lease lapsed: task_id, rank, overdue",
+    "snapshot_rejected": "CMD_METRICS snapshot with out-of-range rank",
+    "metrics_snapshot": "CMD_METRICS snapshot accepted: rank, task_id",
+}
+
 
 @dataclass(frozen=True)
 class Event:

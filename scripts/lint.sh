@@ -1,6 +1,7 @@
 #!/usr/bin/env bash
 # Static checks (the reference's lint step): bytecode-compile every Python
-# file and run native build with warnings-as-errors.
+# file, run the project-specific analyzer, and run the native build with
+# warnings-as-errors.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -9,6 +10,12 @@ cd "$(dirname "$0")/.."
 # against those pieces being moved out of the tree without their checks
 # following.
 python -m compileall -q rabit_tpu rabit_tpu/obs rabit_tpu/obs/trace.py rabit_tpu/chaos.py tests guide tools tools/trace_tool.py bench.py __graft_entry__.py
+
+# tpulint (doc/static_analysis.md): lock discipline, event-kind registry,
+# config-key discipline, wire-protocol symmetry.  Fails on any finding not
+# carried (with a justification) in tools/tpulint/baseline.json.
+python -m tools.tpulint
+
 make -C native clean > /dev/null
 make -C native CXXFLAGS="-O2 -std=c++17 -fPIC -Wall -Wextra -Wno-unused-parameter -Werror" > /dev/null
 echo "lint OK"

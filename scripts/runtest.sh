@@ -12,6 +12,18 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
+# Opt-in sanitizer gate (doc/static_analysis.md): build libtpurabit.so and
+# the native unit tests under TSan, then under ASan+UBSan
+# (-fno-sanitize-recover), and run them.  Separate artifacts — the plain
+# build is untouched.  Run explicitly; the instrumented builds are several
+# times slower than the tier-1 budget allows on every push.
+if [ "${1:-}" = "--sanitize" ]; then
+    make -C native tsan
+    make -C native asan-ubsan
+    echo "sanitize gate OK (native unit tests clean under TSan and ASan+UBSan)"
+    exit 0
+fi
+
 RABIT_OBS_DIR="$(mktemp -d "${TMPDIR:-/tmp}/rabit-obs.XXXXXX")"
 export RABIT_OBS_DIR
 trap 'rm -rf "$RABIT_OBS_DIR"' EXIT

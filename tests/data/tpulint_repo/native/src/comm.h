@@ -1,0 +1,6 @@
+// tpulint fixture: native wire constants skewed against protocol.py.
+#pragma once
+#include <cstdint>
+
+constexpr uint32_t kCmdStart = 2;  // SEEDED: value disagrees with CMD_START
+constexpr uint32_t kCmdQuit = 9;   // SEEDED: no Python counterpart

@@ -1,0 +1,10 @@
+"""tpulint fixture: declared config surface."""
+
+DEFAULTS = {
+    "rabit_fixture_knob": "1",
+    "rabit_undocumented_knob": "0",  # SEEDED: config-key-undocumented
+}
+
+_ENV_TO_KEY = {
+    "DMLC_TASK_ID": "rabit_task_id",
+}

@@ -1,0 +1,6 @@
+"""tpulint fixture: a consumer matching a kind nothing emits."""
+
+
+def watch(events):
+    return [e for e in events
+            if e.kind == "ghost_kind"]  # SEEDED: event-kind-never-emitted

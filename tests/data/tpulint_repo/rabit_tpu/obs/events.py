@@ -1,0 +1,21 @@
+"""tpulint fixture: event-kind registry with seeded violations.
+
+Not product code — a miniature repo-shaped tree that tests/test_tpulint.py
+points ``python -m tools.tpulint --root`` at.  Each ``SEEDED:`` comment
+marks the exact line a finding must name.
+"""
+
+
+def record_event(kind, /, **fields):
+    return (kind, fields)
+
+
+KINDS = {
+    "good_kind": "registered and emitted — the healthy case",
+    "ghost_kind": "registered and consumed but never emitted (SEEDED: event-kind-unused)",
+}
+
+
+def emit_some():
+    record_event("good_kind", x=1)
+    record_event("rogue_kind", x=2)  # SEEDED: event-kind-unregistered

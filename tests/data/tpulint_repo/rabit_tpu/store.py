@@ -1,0 +1,7 @@
+"""tpulint fixture: a read of an undeclared config key."""
+
+
+def resolve(cfg):
+    good = cfg.get("rabit_fixture_knob", "1")
+    bad = cfg.get("rabit_not_a_knob", "")  # SEEDED: config-key-unknown
+    return good, bad

@@ -1,0 +1,12 @@
+"""tpulint fixture: wire constants and a one-sided struct format."""
+
+import struct
+
+CMD_START = 1  # SEEDED: wire-cmd-mismatch (comm.h says kCmdStart = 2)
+CMD_PING = 7  # SEEDED: wire-cmd-unhandled (no tracker branch)
+
+_HDR = struct.Struct("<II")  # packed below, never unpacked
+
+
+def pack_hdr(a, b):
+    return _HDR.pack(a, b)  # SEEDED: wire-struct-oneway

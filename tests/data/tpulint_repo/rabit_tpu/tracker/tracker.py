@@ -1,0 +1,16 @@
+"""tpulint fixture: a blocking call under a held lock."""
+
+import threading
+import time
+
+from rabit_tpu.tracker.protocol import CMD_START
+
+
+class Registrar:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def handle(self, cmd):
+        if cmd == CMD_START:
+            with self._lock:
+                time.sleep(0.1)  # SEEDED: lock-blocking-call

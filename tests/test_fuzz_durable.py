@@ -31,6 +31,7 @@ from __future__ import annotations
 
 import os
 import random
+import re
 import sys
 from pathlib import Path
 
@@ -64,8 +65,13 @@ def draw_scenario(seed: int) -> dict:
         "niter": niter,
         "use_local": damage.startswith("local_") or rng.random() < 0.4,
         "blob": rng.random() < 0.25,
-        # Per-rank skew lands ranks on DIFFERENT sides of a commit barrier
-        # (the skewed-preemption case the aligned stop_at tests cannot hit).
+        # Per-rank kill-instant skew, drawn from 0-0.1s.  The skew is
+        # NOMINAL: with max_restarts=0 the launcher raises on the first
+        # observed death and its cleanup SIGKILLs the survivors at once,
+        # so later entries are often compressed toward the first kill.
+        # Enough schedules still land ranks on different sides of a
+        # commit barrier (the skewed-preemption case the aligned stop_at
+        # tests cannot hit) — the draw is a bias, not a guarantee.
         "preempt": [(base + rng.uniform(0.0, 0.1), r) for r in range(world)],
         "damage": damage,
         "damage_rank": rng.randrange(world),
@@ -93,12 +99,19 @@ def test_fuzzed_whole_job_preemption(seed: int, tmp_path):
     # what job 2 finds on disk.
     c1 = LocalCluster(sc["world"], max_restarts=0, quiet=True)
     try:
+        # TimeoutError too: LocalCluster raises it on the 90s deadline
+        # (it is an OSError subclass, NOT a RuntimeError), and "any
+        # outcome of job 1 is legal" includes running out the clock.
         c1.run(cmd, preempt=sc["preempt"], timeout=90.0)
-    except RuntimeError:
+    except (RuntimeError, TimeoutError):
         pass
 
     kind = "local" if sc["damage"].startswith("local_") else "global"
-    files = sorted(tmp_path.glob(f"{kind}_r{sc['damage_rank']}_v*.bin"))
+    # Newest by PARSED version: lexicographic sorting puts v10 before v2,
+    # so the damage draw would silently hit a stale file at version >= 10.
+    files = sorted(
+        tmp_path.glob(f"{kind}_r{sc['damage_rank']}_v*.bin"),
+        key=lambda p: int(re.search(r"_v(\d+)", p.name).group(1)))
     if files and sc["damage"].endswith("delete"):
         files[-1].unlink()
     elif files and sc["damage"].endswith("truncate"):

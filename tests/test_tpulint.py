@@ -1,0 +1,155 @@
+"""tpulint (tools/tpulint) — the project-specific static analyzer.
+
+Three properties (ISSUE 4 acceptance):
+
+* every check family flags its seeded fixture violation with the right
+  rule id at the right file:line (tests/data/tpulint_repo is a miniature
+  repo-shaped tree, one ``SEEDED:`` marker per finding);
+* the real tree is clean: ``python -m tools.tpulint`` exits 0, with every
+  suppression in tools/tpulint/baseline.json justified;
+* the baseline mechanism round-trips: ``--write-baseline`` emits TODO
+  entries that the tool then REFUSES to load; filling in justifications
+  makes the same findings suppress cleanly; a fixed finding surfaces as a
+  stale entry without failing the run.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parents[1]
+FIXTURE = Path(__file__).parent / "data" / "tpulint_repo"
+
+
+def run_tpulint(*args: str) -> subprocess.CompletedProcess:
+    return subprocess.run(
+        [sys.executable, "-m", "tools.tpulint", *args],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+
+
+def seeded_line(relpath: str, rule: str) -> int:
+    """Line number of the ``SEEDED: <rule>`` marker in a fixture file."""
+    for i, line in enumerate(
+            (FIXTURE / relpath).read_text().splitlines(), 1):
+        if f"SEEDED: {rule}" in line:
+            return i
+    raise AssertionError(f"no SEEDED: {rule} marker in {relpath}")
+
+
+# -- fixture violations: one per family, right rule, right file:line ---------
+
+@pytest.mark.parametrize("rule,relpath", [
+    # family 1: lock discipline
+    ("lock-blocking-call", "rabit_tpu/tracker/tracker.py"),
+    # family 2: event-kind registry (all three directions)
+    ("event-kind-unregistered", "rabit_tpu/obs/events.py"),
+    ("event-kind-never-emitted", "rabit_tpu/obs/consumer.py"),
+    ("event-kind-unused", "rabit_tpu/obs/events.py"),
+    # family 3: config-key discipline (read, doc->code, code->doc)
+    ("config-key-unknown", "rabit_tpu/store.py"),
+    ("config-key-undefaulted", "doc/parameters.md"),
+    ("config-key-undocumented", "rabit_tpu/config.py"),
+    # family 4: wire-protocol symmetry
+    ("wire-cmd-mismatch", "rabit_tpu/tracker/protocol.py"),
+    ("wire-cmd-unhandled", "rabit_tpu/tracker/protocol.py"),
+    ("wire-struct-oneway", "rabit_tpu/tracker/protocol.py"),
+])
+def test_fixture_violation_flagged(rule, relpath):
+    proc = run_tpulint("--root", str(FIXTURE))
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    if rule in ("event-kind-unused", "config-key-undocumented"):
+        # These anchor to the declaration (KINDS entry / DEFAULTS dict),
+        # not to a SEEDED marker line; asserting rule + file is exact
+        # enough (the declaration moves with the dict).
+        pat = re.compile(
+            rf"^{re.escape(relpath)}:\d+: \[{re.escape(rule)}\]")
+    else:
+        line = seeded_line(relpath, rule)
+        pat = re.compile(
+            rf"^{re.escape(relpath)}:{line}: \[{re.escape(rule)}\]")
+    assert any(pat.match(l) for l in proc.stdout.splitlines()), (
+        f"expected {rule} at {relpath}: got\n{proc.stdout}")
+
+
+def test_fixture_native_only_constant_flagged():
+    """A native kCmd with no Python counterpart is a mismatch finding
+    anchored in comm.h."""
+    proc = run_tpulint("--root", str(FIXTURE))
+    assert re.search(
+        r"^native/src/comm\.h:\d+: \[wire-cmd-mismatch\] native constant "
+        r"CMD_QUIT", proc.stdout, re.M), proc.stdout
+
+
+# -- the real tree is clean --------------------------------------------------
+
+def test_repo_tree_is_clean():
+    proc = run_tpulint()
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "0 new finding(s)" in proc.stdout
+
+
+def test_repo_baseline_entries_all_justified_and_live():
+    """Every baseline suppression suppresses a real finding (no stale
+    entries) and carries a non-TODO justification — enforced by the
+    loader, re-asserted here against the committed file."""
+    doc = json.loads(
+        (REPO / "tools" / "tpulint" / "baseline.json").read_text())
+    assert doc["version"] == 1
+    for entry in doc["suppressions"]:
+        why = entry["justification"].strip()
+        assert why and not why.upper().startswith("TODO"), entry
+    proc = run_tpulint()
+    assert "0 stale" in proc.stdout, proc.stdout
+
+
+# -- baseline round-trip ------------------------------------------------------
+
+def test_baseline_round_trip(tmp_path):
+    baseline = tmp_path / "baseline.json"
+
+    # 1. --write-baseline emits one TODO entry per finding...
+    proc = run_tpulint("--root", str(FIXTURE), "--baseline", str(baseline),
+                       "--write-baseline")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    doc = json.loads(baseline.read_text())
+    assert doc["suppressions"], "fixture tree should have findings"
+
+    # 2. ...which the tool refuses to load as-is (TODO is not a reason).
+    proc = run_tpulint("--root", str(FIXTURE), "--baseline", str(baseline))
+    assert proc.returncode == 2
+    assert "justification" in proc.stderr
+
+    # 3. Justified entries suppress exactly those findings: clean run.
+    for entry in doc["suppressions"]:
+        entry["justification"] = "fixture: intentionally seeded violation"
+    baseline.write_text(json.dumps(doc))
+    proc = run_tpulint("--root", str(FIXTURE), "--baseline", str(baseline))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "0 new finding(s)" in proc.stdout
+
+    # 4. An entry whose finding was fixed reports as stale WITHOUT
+    # failing the run (prune-when-touched policy).
+    doc["suppressions"].append({
+        "fingerprint": "lock-blocking-call:rabit_tpu/gone.py:f:lock:sleep",
+        "justification": "covers a finding that no longer exists",
+    })
+    baseline.write_text(json.dumps(doc))
+    proc = run_tpulint("--root", str(FIXTURE), "--baseline", str(baseline))
+    assert proc.returncode == 0
+    assert "stale baseline entry" in proc.stdout
+
+
+def test_fingerprints_are_line_number_free():
+    """Baseline fingerprints must survive unrelated line drift: the JSON
+    output's fingerprints contain no line numbers."""
+    proc = run_tpulint("--root", str(FIXTURE), "--json")
+    doc = json.loads(proc.stdout)
+    for f in doc["new"]:
+        rule, path, token = f["fingerprint"].split(":", 2)
+        assert str(f["line"]) not in token.split(":"), f

@@ -1,0 +1,35 @@
+"""tpulint — project-specific static analysis for the tpurabit tree.
+
+``python -m tools.tpulint`` runs four check families over the repo
+(doc/static_analysis.md has the full rule catalogue and the hazard each
+rule guards against):
+
+* **lock discipline** (``lock-blocking-call``) — blocking calls (socket
+  recv/send/accept/connect, ``time.sleep``, ``subprocess.*``, file I/O,
+  ``tracker_rpc``) lexically inside ``with <lock>:`` bodies.  A tracker
+  handler thread sleeping under ``self._lock`` stalls every other
+  handler — including lease renewals, turning one slow client into a
+  cluster-wide false failure.
+* **event-kind registry** (``event-kind-*``) — every emitted obs event
+  ``kind`` must be declared in ``rabit_tpu.obs.events.KINDS`` and every
+  kind a consumer matches on (trace merger, telemetry aggregation,
+  benches, tests) must actually be emitted somewhere.  Catches the
+  rename-drift that silently holes the Perfetto timeline.
+* **config-key discipline** (``config-key-*``) — every ``rabit_*`` /
+  ``DMLC_*`` key read anywhere must exist in ``config.DEFAULTS`` /
+  ``_ENV_TO_KEY``, and ``DEFAULTS`` must stay in sync with
+  ``doc/parameters.md`` both ways.  A typo'd knob otherwise falls back
+  to its default without a sound.
+* **wire-protocol symmetry** (``wire-*``) — ``CMD_*``/``MAGIC_*``
+  constants must agree in value between ``tracker/protocol.py`` and
+  ``native/src/comm.h``, every command must have a tracker-side handler
+  branch, and ``struct`` formats must be used on both the pack and the
+  unpack side.
+
+Findings are suppressed only via the baseline file
+(``tools/tpulint/baseline.json``); every suppression carries a one-line
+justification and the tool rejects baselines without one.  Pure stdlib
+(``ast`` + ``re``); no third-party dependencies.
+"""
+
+from tools.tpulint.core import Finding, load_baseline  # noqa: F401

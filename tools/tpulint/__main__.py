@@ -1,0 +1,157 @@
+"""CLI: ``python -m tools.tpulint [--root DIR] [--json] [--write-baseline]``.
+
+Exit status: 0 — clean (every finding baselined with a justification);
+1 — new findings; 2 — malformed baseline or internal error.  Stale
+baseline entries (suppressing nothing) are reported but do not fail the
+run — prune them when touching the baseline.
+
+``--root`` points at an alternate tree with the repo's layout (used by
+the fixture tests in tests/test_tpulint.py); the default is this repo.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+from tools.tpulint import configkeys, locks, registry, wire
+from tools.tpulint.core import (
+    BaselineError,
+    Finding,
+    iter_python_files,
+    load_baseline,
+    rel,
+    write_baseline,
+)
+
+#: trees that are lint *inputs* but not part of the product surface
+_EXCLUDE_PARTS = ("data",)  # tests/data: fixture trees with seeded bugs
+
+
+def run(root: Path) -> list[Finding]:
+    """All four families over a repo-layout tree rooted at ``root``."""
+    findings: list[Finding] = []
+
+    # 1. lock discipline — the whole package (tracker, obs, store, chaos,
+    # engines); the threaded surfaces the ISSUE names are all inside it.
+    lock_files = iter_python_files(root, ["rabit_tpu/**/*.py"])
+    findings += locks.check_locks(lock_files, root)
+
+    # 2. event-kind registry
+    events_py = root / "rabit_tpu" / "obs" / "events.py"
+    kinds = registry.load_kinds(events_py)
+    emit_files = iter_python_files(root, ["rabit_tpu/**/*.py"])
+    consume_files = iter_python_files(
+        root,
+        ["rabit_tpu/obs/**/*.py", "rabit_tpu/tracker/*.py",
+         "tools/*.py", "tests/**/*.py"],
+        exclude_parts=_EXCLUDE_PARTS)
+    emitted = registry.collect_emitted(emit_files, root)
+    consumed = registry.collect_consumed(consume_files, root)
+    local = registry.collect_emitted(
+        [p for p in consume_files if p not in set(emit_files)], root)
+    findings += registry.check_event_kinds(
+        kinds, emitted, consumed, local_emitted=local,
+        events_py_rel=rel(events_py, root))
+
+    # 3. config-key discipline
+    config_py = root / "rabit_tpu" / "config.py"
+    defaults_keys, env_values, dmlc = configkeys.declared_keys(config_py)
+    declared = defaults_keys | env_values
+    py_read_files = iter_python_files(
+        root,
+        ["rabit_tpu/**/*.py", "tools/*.py", "tests/**/*.py",
+         "guide/**/*.py", "bench.py"],
+        exclude_parts=_EXCLUDE_PARTS)
+    native_files = [p for p in
+                    sorted((root / "native").glob("**/*"))
+                    if p.suffix in (".cc", ".h") and p.is_file()]
+    findings += configkeys.check_config_keys(
+        declared=declared,
+        dmlc_declared=dmlc,
+        python_reads=configkeys.collect_python_reads(py_read_files, root),
+        native_reads=configkeys.collect_native_reads(native_files, root),
+        documented=configkeys.doc_keys(root / "doc" / "parameters.md"),
+        defaults_keys=defaults_keys,
+        config_py_rel=rel(config_py, root),
+        parameters_md_rel="doc/parameters.md",
+    )
+
+    # 4. wire-protocol symmetry
+    protocol_py = root / "rabit_tpu" / "tracker" / "protocol.py"
+    tracker_py = root / "rabit_tpu" / "tracker" / "tracker.py"
+    comm_h = root / "native" / "src" / "comm.h"
+    struct_files = iter_python_files(root, ["rabit_tpu/**/*.py"])
+    findings += wire.check_wire(protocol_py, tracker_py, comm_h,
+                                struct_files, root)
+
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tools.tpulint",
+        description="project-specific static analysis "
+                    "(doc/static_analysis.md)")
+    ap.add_argument("--root", default=None,
+                    help="repo-layout tree to lint (default: this repo)")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline file (default: ROOT/tools/tpulint/"
+                         "baseline.json)")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="write current findings as TODO-justified "
+                         "baseline entries and exit (the tool refuses to "
+                         "load TODOs — fill in each justification)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable findings on stdout")
+    args = ap.parse_args(argv)
+
+    root = Path(args.root).resolve() if args.root else \
+        Path(__file__).resolve().parents[2]
+    baseline_path = Path(args.baseline) if args.baseline else \
+        root / "tools" / "tpulint" / "baseline.json"
+
+    findings = run(root)
+
+    if args.write_baseline:
+        write_baseline(baseline_path, findings)
+        print(f"tpulint: wrote {len(findings)} TODO suppression(s) to "
+              f"{baseline_path}; fill in each justification before the "
+              f"baseline will load")
+        return 0
+
+    try:
+        baseline = load_baseline(baseline_path)
+    except BaselineError as exc:
+        print(f"tpulint: {exc}", file=sys.stderr)
+        return 2
+
+    new = [f for f in findings if f.fingerprint not in baseline]
+    suppressed = [f for f in findings if f.fingerprint in baseline]
+    stale = sorted(set(baseline) - {f.fingerprint for f in findings})
+
+    if args.json:
+        print(json.dumps({
+            "new": [f.__dict__ | {"fingerprint": f.fingerprint}
+                    for f in new],
+            "suppressed": [f.fingerprint for f in suppressed],
+            "stale_baseline": stale,
+        }, indent=1))
+    else:
+        for f in new:
+            print(f.render())
+        for fp in stale:
+            print(f"tpulint: stale baseline entry (suppresses nothing): "
+                  f"{fp}")
+        summary = (f"tpulint: {len(new)} new finding(s), "
+                   f"{len(suppressed)} baselined, {len(stale)} stale "
+                   f"baseline entr{'y' if len(stale) == 1 else 'ies'}")
+        print(summary)
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,196 @@
+"""Config-key discipline: reads ↔ DEFAULTS/_ENV_TO_KEY ↔ doc/parameters.md.
+
+``Config.get*`` silently falls back to its default for an unknown key —
+by design (layered overrides), but it means a typo'd read
+(``rabit_hearbeat_sec``) disables the feature without a sound.  The
+declared surface is ``config.DEFAULTS`` plus the env-var map
+``_ENV_TO_KEY``; this check pins three invariants:
+
+* ``config-key-unknown`` — a ``rabit_*``/``DMLC_*`` key *read* anywhere
+  (Python ``.get/.get_int/.get_bool/.get_size``/subscript/`in` tests,
+  native ``cfg.Get*("...")`` string literals) that the declared surface
+  does not contain;
+* ``config-key-undocumented`` — a ``DEFAULTS`` key missing from
+  ``doc/parameters.md`` (an invisible knob);
+* ``config-key-undefaulted`` — a ``rabit_*`` key documented in
+  ``doc/parameters.md`` that the declared surface does not contain
+  (stale doc, or a native-engine-owned key — the latter belongs in the
+  baseline with its justification, see tools/tpulint/baseline.json).
+
+Uppercase ``RABIT_*`` environment variables (``RABIT_OBS_DIR``, fuzz
+campaign knobs) are process-environment surface, not config keys, and are
+out of scope except through ``_ENV_TO_KEY``.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+
+from tools.tpulint.core import Finding, const_str, parse_python, rel
+
+RULE_UNKNOWN = "config-key-unknown"
+RULE_UNDOCUMENTED = "config-key-undocumented"
+RULE_UNDEFAULTED = "config-key-undefaulted"
+
+_KEY_RE = re.compile(r"^rabit_[a-z0-9_]+$")
+_DMLC_RE = re.compile(r"^DMLC_[A-Z0-9_]+$")
+_GETTERS = frozenset({"get", "get_int", "get_bool", "get_size"})
+#: native config accessors (comm.cc Config helpers)
+_NATIVE_KEY_RE = re.compile(r'"(rabit_[a-z0-9_]+)"')
+#: must end alphanumeric so prose globs like ``rabit_xla_*`` don't leave a
+#: dangling-underscore pseudo-key
+_DOC_KEY_RE = re.compile(r"rabit_[a-z0-9_]*[a-z0-9]")
+
+
+def declared_keys(config_py: Path) -> tuple[set[str], set[str], set[str]]:
+    """(DEFAULTS keys, _ENV_TO_KEY canonical values, DMLC env names)
+    declared in config.py."""
+    tree = parse_python(config_py)
+    defaults: set[str] = set()
+    env_values: set[str] = set()
+    dmlc: set[str] = set()
+    if tree is None:
+        return defaults, env_values, dmlc
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            names = [t.id for t in node.targets if isinstance(t, ast.Name)]
+        elif isinstance(node, ast.AnnAssign):
+            names = [node.target.id] if isinstance(node.target,
+                                                   ast.Name) else []
+        else:
+            continue
+        if not isinstance(node.value, ast.Dict):
+            continue
+        if "DEFAULTS" in names:
+            for k in node.value.keys:
+                s = const_str(k) if k is not None else None
+                if s is not None:
+                    defaults.add(s)
+        elif "_ENV_TO_KEY" in names:
+            for k, v in zip(node.value.keys, node.value.values):
+                ks = const_str(k) if k is not None else None
+                vs = const_str(v)
+                if ks is not None and _DMLC_RE.match(ks):
+                    dmlc.add(ks)
+                if vs is not None:
+                    env_values.add(vs)
+    return defaults, env_values, dmlc
+
+
+def _key_of(s: str | None) -> str | None:
+    if s is not None and (_KEY_RE.match(s) or _DMLC_RE.match(s)):
+        return s
+    return None
+
+
+def collect_python_reads(files: list[Path],
+                         root: Path) -> list[tuple[str, int, str]]:
+    """(relpath, line, key) for every key-shaped string used as a read:
+    first argument of a ``.get*()`` call, a subscript index, or the left
+    side of an ``in``/``not in`` containment test."""
+    out: list[tuple[str, int, str]] = []
+    for path in files:
+        tree = parse_python(path)
+        if tree is None:
+            continue
+        rpath = rel(path, root)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call):
+                fn = node.func
+                if (isinstance(fn, ast.Attribute) and fn.attr in _GETTERS
+                        and node.args):
+                    key = _key_of(const_str(node.args[0]))
+                    if key is not None:
+                        out.append((rpath, node.lineno, key))
+            elif isinstance(node, ast.Subscript):
+                key = _key_of(const_str(node.slice))
+                if key is not None:
+                    out.append((rpath, node.lineno, key))
+            elif isinstance(node, ast.Compare):
+                if len(node.ops) == 1 and isinstance(node.ops[0],
+                                                     (ast.In, ast.NotIn)):
+                    key = _key_of(const_str(node.left))
+                    if key is not None:
+                        out.append((rpath, node.lineno, key))
+    return out
+
+
+def collect_native_reads(files: list[Path],
+                         root: Path) -> list[tuple[str, int, str]]:
+    """(relpath, line, key) for every quoted rabit_* literal in the native
+    sources — the C++ config reads (comm.cc `cfg.Get*("rabit_x", ...)`)."""
+    out: list[tuple[str, int, str]] = []
+    for path in files:
+        try:
+            text = path.read_text(encoding="utf-8", errors="replace")
+        except OSError:
+            continue
+        rpath = rel(path, root)
+        for i, line in enumerate(text.splitlines(), 1):
+            for m in _NATIVE_KEY_RE.finditer(line):
+                out.append((rpath, i, m.group(1)))
+    return out
+
+
+def doc_keys(parameters_md: Path) -> dict[str, int]:
+    """rabit_* keys mentioned in doc/parameters.md -> first line seen.
+    ``rabit_tpu``-prefixed tokens are package/module references, not
+    keys."""
+    out: dict[str, int] = {}
+    try:
+        text = parameters_md.read_text(encoding="utf-8")
+    except OSError:
+        return out
+    for i, line in enumerate(text.splitlines(), 1):
+        for m in _DOC_KEY_RE.finditer(line):
+            tok = m.group(0)
+            if tok == "rabit_tpu" or tok.startswith("rabit_tpu_"):
+                continue
+            if m.end() < len(line) and line[m.end()] in "_*":
+                continue  # glob prose like ``rabit_xla_*``, not a key
+            out.setdefault(tok, i)
+    return out
+
+
+def check_config_keys(
+    declared: set[str],
+    dmlc_declared: set[str],
+    python_reads: list[tuple[str, int, str]],
+    native_reads: list[tuple[str, int, str]],
+    documented: dict[str, int],
+    defaults_keys: set[str],
+    config_py_rel: str = "rabit_tpu/config.py",
+    parameters_md_rel: str = "doc/parameters.md",
+) -> list[Finding]:
+    findings: list[Finding] = []
+    seen: set[tuple[str, str]] = set()
+    for rpath, line, key in python_reads + native_reads:
+        ok = key in dmlc_declared if key.startswith("DMLC_") \
+            else key in declared
+        if ok or (rpath, key) in seen:
+            continue
+        seen.add((rpath, key))
+        findings.append(Finding(
+            RULE_UNKNOWN, rpath, line,
+            f"config key {key!r} is read here but not declared in "
+            f"config.DEFAULTS/_ENV_TO_KEY — a typo would silently fall "
+            f"back to the getter default",
+            token=key))
+    for key in sorted(defaults_keys):
+        if key not in documented:
+            findings.append(Finding(
+                RULE_UNDOCUMENTED, config_py_rel, 1,
+                f"DEFAULTS key {key!r} is not documented in "
+                f"doc/parameters.md — an invisible knob",
+                token=key))
+    for key, line in sorted(documented.items()):
+        if key not in declared:
+            findings.append(Finding(
+                RULE_UNDEFAULTED, parameters_md_rel, line,
+                f"doc/parameters.md documents {key!r} which is not in "
+                f"config.DEFAULTS/_ENV_TO_KEY (stale doc, or a "
+                f"native-engine-owned key that belongs in the baseline)",
+                token=key))
+    return findings

@@ -1,0 +1,156 @@
+"""Shared tpulint plumbing: findings, file parsing, the baseline format.
+
+A finding's **fingerprint** deliberately excludes the line number — it is
+``rule:relative-path:token`` where ``token`` names the construct (the lock
+and blocking call, the event kind, the config key, the wire constant), so
+a baseline entry survives unrelated edits that shift lines.  The reported
+``file:line`` is still exact for navigation.
+
+Baseline file (``tools/tpulint/baseline.json``)::
+
+    {
+      "version": 1,
+      "suppressions": [
+        {"fingerprint": "config-key-unknown:native/src/comm.cc:rabit_x",
+         "justification": "one line explaining why this is not a bug"}
+      ]
+    }
+
+Suppressions without a non-empty justification (or with a ``TODO``
+placeholder, which ``--write-baseline`` emits) are rejected: the
+allowlist is a ledger of *argued* exceptions, not a mute button.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+from dataclasses import dataclass
+from pathlib import Path
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str      # repo-relative, forward slashes
+    line: int
+    message: str
+    token: str     # stable construct key (no line number)
+
+    @property
+    def fingerprint(self) -> str:
+        return f"{self.rule}:{self.path}:{self.token}"
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+def rel(path: str | os.PathLike, root: str | os.PathLike) -> str:
+    try:
+        r = Path(path).resolve().relative_to(Path(root).resolve())
+    except ValueError:
+        r = Path(path)
+    return r.as_posix()
+
+
+def parse_python(path: str | os.PathLike) -> ast.Module | None:
+    """Parse one file; a syntax error yields None (compileall owns syntax —
+    tpulint must not double-report or crash on it)."""
+    try:
+        src = Path(path).read_text(encoding="utf-8")
+    except OSError:
+        return None
+    try:
+        return ast.parse(src, filename=os.fspath(path))
+    except SyntaxError:
+        return None
+
+
+def iter_python_files(root: Path, patterns: list[str],
+                      exclude_parts: tuple[str, ...] = ()) -> list[Path]:
+    """Glob ``patterns`` under ``root``, dropping anything whose path
+    contains one of ``exclude_parts`` (fixture trees, __pycache__)."""
+    out: list[Path] = []
+    seen: set[Path] = set()
+    for pat in patterns:
+        for p in sorted(root.glob(pat)):
+            if not p.is_file() or p in seen:
+                continue
+            parts = p.relative_to(root).parts
+            if any(x in parts for x in ("__pycache__", *exclude_parts)):
+                continue
+            seen.add(p)
+            out.append(p)
+    return out
+
+
+def const_str(node: ast.AST) -> str | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def const_strs(node: ast.AST) -> list[str]:
+    """String constants of a tuple/list/set literal (else empty)."""
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        out = [const_str(e) for e in node.elts]
+        return [s for s in out if s is not None]
+    return []
+
+
+# -- baseline ----------------------------------------------------------------
+
+BASELINE_VERSION = 1
+
+
+class BaselineError(ValueError):
+    """Malformed baseline file (bad JSON, missing/placeholder
+    justification, wrong version)."""
+
+
+def load_baseline(path: str | os.PathLike) -> dict[str, str]:
+    """fingerprint -> justification.  A missing file is an empty baseline;
+    a malformed one raises :class:`BaselineError`."""
+    p = Path(path)
+    if not p.exists():
+        return {}
+    try:
+        doc = json.loads(p.read_text(encoding="utf-8"))
+    except (OSError, ValueError) as exc:
+        raise BaselineError(f"unreadable baseline {p}: {exc}") from exc
+    if not isinstance(doc, dict) or doc.get("version") != BASELINE_VERSION:
+        raise BaselineError(
+            f"baseline {p}: expected a version={BASELINE_VERSION} document")
+    out: dict[str, str] = {}
+    for i, entry in enumerate(doc.get("suppressions", [])):
+        if not isinstance(entry, dict):
+            raise BaselineError(f"baseline {p}: suppressions[{i}] is not an "
+                                f"object")
+        fp = entry.get("fingerprint")
+        why = str(entry.get("justification", "")).strip()
+        if not isinstance(fp, str) or not fp:
+            raise BaselineError(
+                f"baseline {p}: suppressions[{i}] has no fingerprint")
+        if not why or why.upper().startswith("TODO"):
+            raise BaselineError(
+                f"baseline {p}: suppression {fp!r} has no justification — "
+                f"every allowlisted finding must argue why it is not a bug")
+        out[fp] = why
+    return out
+
+
+def write_baseline(path: str | os.PathLike,
+                   findings: list[Finding]) -> None:
+    """Emit a baseline covering ``findings`` with TODO justifications.
+    The tool refuses to LOAD such a file until each TODO is replaced —
+    regenerating the baseline is the start of the workflow, not the end."""
+    doc = {
+        "version": BASELINE_VERSION,
+        "suppressions": [
+            {"fingerprint": f.fingerprint,
+             "justification": f"TODO: justify ({f.message})"}
+            for f in sorted(findings, key=lambda f: f.fingerprint)
+        ],
+    }
+    Path(path).write_text(json.dumps(doc, indent=1) + "\n", encoding="utf-8")

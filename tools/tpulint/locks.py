@@ -1,0 +1,188 @@
+"""Lock-discipline check: no blocking calls under a held lock.
+
+The hazard is concrete in this codebase: the tracker serves every worker
+connection from a handler thread and guards shared state with
+``self._lock``; the obs layer's watchdog/heartbeat threads share
+``_STATE.lock`` with the collective hot path.  A thread that sleeps,
+touches a socket, spawns a subprocess, or does file I/O while holding one
+of those locks stalls every other thread that needs it — in the tracker's
+case that includes lease renewals, so one slow client can make healthy
+workers look dead (doc/fault_tolerance.md).
+
+The analysis is lexical over the AST: inside the body of
+``with <something named like a lock>:`` (nested function/lambda bodies
+excluded — they run later, elsewhere), flag
+
+* ``time.sleep`` and bare ``sleep``,
+* socket-shaped attribute calls (``recv``/``recv_into``/``recvfrom``/
+  ``send``/``sendall``/``sendto``/``accept``/``connect``/``connect_ex``),
+  ``socket.create_connection``, and blocking waits (``.wait``,
+  ``.join`` on thread-like receivers, ``.communicate``),
+* anything on the ``subprocess`` module,
+* file I/O: ``open``, ``os.fsync``, ``Path.read_/write_bytes|text``,
+* ``tracker_rpc`` (the bounded-but-seconds-long tracker round-trip).
+
+Calls to helpers **defined in the same module** are resolved one level
+deep, so ``with self._lock: self._helper()`` is caught when the helper
+blocks (reported as ``via <helper>``).  Deeper indirection is out of
+scope — the checked modules keep their lock bodies shallow by design.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+
+from tools.tpulint.core import Finding, parse_python, rel
+
+RULE = "lock-blocking-call"
+
+#: Attribute names that block regardless of receiver (socket/file/thread
+#: shaped).  ``join`` is deliberately absent: ``str.join`` would swamp the
+#: signal; thread joins under a lock are caught via ``wait``/helpers.
+_BLOCKING_ATTRS = frozenset({
+    "recv", "recv_into", "recvfrom", "recv_exact",
+    "send", "sendall", "sendto",
+    "accept", "connect", "connect_ex",
+    "wait", "communicate",
+    "read_bytes", "write_bytes", "read_text", "write_text",
+})
+
+#: module-level calls: {module name: attrs} (None = every attr blocks).
+_BLOCKING_MODULE_ATTRS: dict[str, frozenset | None] = {
+    "subprocess": None,
+    "time": frozenset({"sleep"}),
+    "socket": frozenset({"create_connection", "getaddrinfo"}),
+    "os": frozenset({"fsync"}),
+}
+
+#: bare-name calls that block.
+_BLOCKING_NAMES = frozenset({"open", "sleep", "tracker_rpc"})
+
+
+def _lockish(expr: ast.expr) -> str | None:
+    """Name of a with-item that looks like a lock, else None."""
+    if isinstance(expr, ast.Attribute) and "lock" in expr.attr.lower():
+        base = expr.value
+        prefix = base.id + "." if isinstance(base, ast.Name) else (
+            base.attr + "." if isinstance(base, ast.Attribute) else "")
+        return prefix + expr.attr
+    if isinstance(expr, ast.Name) and "lock" in expr.id.lower():
+        return expr.id
+    # with lock.acquire_timeout(...) / contextlib wrappers: not used here
+    return None
+
+
+def _blocking_call(call: ast.Call) -> str | None:
+    """Describe why this call blocks, else None."""
+    fn = call.func
+    if isinstance(fn, ast.Attribute):
+        if (isinstance(fn.value, ast.Name)
+                and fn.value.id in _BLOCKING_MODULE_ATTRS):
+            allowed = _BLOCKING_MODULE_ATTRS[fn.value.id]
+            if allowed is None or fn.attr in allowed:
+                return f"{fn.value.id}.{fn.attr}"
+        if fn.attr in _BLOCKING_ATTRS:
+            return f".{fn.attr}"
+        if fn.attr == "tracker_rpc":
+            return "tracker_rpc"
+    elif isinstance(fn, ast.Name) and fn.id in _BLOCKING_NAMES:
+        return fn.id
+    return None
+
+
+def _body_calls(nodes: list[ast.stmt]):
+    """Every Call in these statements, excluding nested function/class
+    bodies (deferred execution) — lambdas included in the exclusion."""
+    stack: list[ast.AST] = list(nodes)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            continue
+        if isinstance(node, ast.Call):
+            yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _local_defs(tree: ast.Module) -> dict[str, ast.FunctionDef]:
+    """simple name -> def, for module-level functions and methods."""
+    defs: dict[str, ast.FunctionDef] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef):
+            defs.setdefault(node.name, node)
+    return defs
+
+
+def _callee_name(call: ast.Call) -> str | None:
+    fn = call.func
+    if isinstance(fn, ast.Name):
+        return fn.id
+    if isinstance(fn, ast.Attribute) and isinstance(fn.value, ast.Name) \
+            and fn.value.id in ("self", "cls"):
+        return fn.attr
+    return None
+
+
+def _enclosing_funcs(tree: ast.Module) -> dict[int, str]:
+    """id(with-node) -> enclosing function name (for finding tokens)."""
+    out: dict[int, str] = {}
+
+    def visit(node: ast.AST, fname: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            here = fname
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                here = child.name
+            if isinstance(child, ast.With):
+                out[id(child)] = here
+            visit(child, here)
+
+    visit(tree, "<module>")
+    return out
+
+
+def check_locks(files: list[Path], root: Path) -> list[Finding]:
+    findings: list[Finding] = []
+    for path in files:
+        tree = parse_python(path)
+        if tree is None:
+            continue
+        defs = _local_defs(tree)
+        owner = _enclosing_funcs(tree)
+        rpath = rel(path, root)
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.With):
+                continue
+            locks = [n for n in (_lockish(item.context_expr)
+                                 for item in node.items) if n]
+            if not locks:
+                continue
+            lock = locks[0]
+            fname = owner.get(id(node), "<module>")
+            for call in _body_calls(node.body):
+                why = _blocking_call(call)
+                via = ""
+                if why is None:
+                    # one-level resolution of same-module helpers
+                    callee = _callee_name(call)
+                    target = defs.get(callee) if callee else None
+                    if target is not None:
+                        for inner in _body_calls(target.body):
+                            inner_why = _blocking_call(inner)
+                            if inner_why is not None:
+                                why = inner_why
+                                via = f" via {callee}()"
+                                break
+                if why is None:
+                    continue
+                findings.append(Finding(
+                    rule=RULE,
+                    path=rpath,
+                    line=call.lineno,
+                    message=(f"blocking call {why}{via} while holding "
+                             f"{lock} (in {fname}); a thread stalled here "
+                             f"holds every other user of the lock"),
+                    token=f"{fname}:{lock}:{why}"
+                          + (f":via:{_callee_name(call)}" if via else ""),
+                ))
+    return findings

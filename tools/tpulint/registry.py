@@ -1,0 +1,241 @@
+"""Event-kind registry check: emitted ↔ declared ↔ consumed.
+
+The obs pipeline is stringly typed end to end: a producer calls
+``record_event("op_begin", ...)`` and a consumer three modules away does
+``if ev.kind == "op_begin"``.  A typo or a rename on either side fails
+*silently* — the trace merger simply never sees the event, the telemetry
+tally reads zero, the Perfetto timeline has a hole.  The declared
+``KINDS`` registry in ``rabit_tpu/obs/events.py`` is the single point of
+truth; this check closes the triangle:
+
+* ``event-kind-unregistered`` — an emitted or consumed kind that is not
+  declared in ``KINDS``;
+* ``event-kind-never-emitted`` — a kind some consumer matches on that no
+  producer ever emits (rename drift: the consumer is dead code and its
+  signal is gone);
+* ``event-kind-unused`` — a ``KINDS`` entry nothing emits (stale
+  registry, or the producer was deleted out from under it).
+
+Emissions recognized (product code): ``record_event("k", ...)`` /
+``obs_event("k", ...)`` / ``<recorder>.record("k", ...)``, direct
+``Event(ts, "k", ...)`` construction, dict literals carrying
+``"kind": "k"`` (the tracker's telemetry events), and ``kind = "k"``
+assignments (the stats-line bridge in events.py).  Consumptions
+recognized: ``X.kind == "k"`` / ``X["kind"] == "k"`` / ``.get("kind")``
+comparisons (also ``!=`` and ``in (tuple)``), ``"k" in <kinds-ish name>``
+membership, and ALL-CAPS set literals whose name mentions KIND/INSTANT
+(the trace exporter's ``_RANK_INSTANTS``/``_TRACKER_INSTANTS``).
+
+Test files may mint private kinds for fixture rings; a kind emitted in
+the *same file* that consumes it is exempt from both registry rules.
+Single-character strings are ignored (``np.dtype(...).kind == "f"``).
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+
+from tools.tpulint.core import Finding, const_str, const_strs, parse_python, rel
+
+RULE_UNREGISTERED = "event-kind-unregistered"
+RULE_NEVER_EMITTED = "event-kind-never-emitted"
+RULE_UNUSED = "event-kind-unused"
+
+_EMIT_FUNCS = frozenset({"record_event", "obs_event"})
+
+#: occurrence: (relpath, line, kind)
+Occurrence = tuple[str, int, str]
+
+
+def load_kinds(events_py: Path) -> dict[str, int]:
+    """kind -> declaration line from the ``KINDS = {...}`` literal in
+    events.py (empty when the registry is missing — every emission then
+    reports as unregistered, which is the loud failure we want)."""
+    tree = parse_python(events_py)
+    if tree is None:
+        return {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            names = [t.id for t in node.targets if isinstance(t, ast.Name)]
+        elif isinstance(node, ast.AnnAssign):
+            names = [node.target.id] if isinstance(node.target,
+                                                   ast.Name) else []
+        else:
+            continue
+        if "KINDS" not in names or not isinstance(node.value, ast.Dict):
+            continue
+        out: dict[str, int] = {}
+        for key in node.value.keys:
+            s = const_str(key) if key is not None else None
+            if s is not None:
+                out[s] = key.lineno
+        return out
+    return {}
+
+
+def _kindish_name(name: str) -> bool:
+    return "kind" in name.lower()
+
+
+def collect_emitted(files: list[Path], root: Path) -> list[Occurrence]:
+    out: list[Occurrence] = []
+    for path in files:
+        tree = parse_python(path)
+        if tree is None:
+            continue
+        rpath = rel(path, root)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call):
+                fn = node.func
+                name = (fn.id if isinstance(fn, ast.Name)
+                        else fn.attr if isinstance(fn, ast.Attribute)
+                        else None)
+                if name in _EMIT_FUNCS or name == "record":
+                    if node.args:
+                        s = const_str(node.args[0])
+                        if s is not None:
+                            out.append((rpath, node.lineno, s))
+                elif name == "Event" and len(node.args) >= 2:
+                    s = const_str(node.args[1])
+                    if s is not None:
+                        out.append((rpath, node.lineno, s))
+            elif isinstance(node, ast.Dict):
+                for key, value in zip(node.keys, node.values):
+                    if key is not None and const_str(key) == "kind":
+                        s = const_str(value)
+                        if s is not None:
+                            out.append((rpath, value.lineno, s))
+            elif isinstance(node, ast.Assign):
+                # kind = "..." assignments are an emission pattern only in
+                # the stats-line bridge (events.py builds the Event from
+                # the assigned name); elsewhere "kind" is a generic word
+                # (engine kinds, dtype kinds) and would drown the signal.
+                if not rpath.endswith("obs/events.py"):
+                    continue
+                targets = [t.id for t in node.targets
+                           if isinstance(t, ast.Name)]
+                if "kind" in targets:
+                    s = const_str(node.value)
+                    if s is not None:
+                        out.append((rpath, node.lineno, s))
+    return out
+
+
+def _compare_consumptions(node: ast.Compare) -> list[str]:
+    """Kind strings consumed by one Compare node."""
+    left = node.left
+    kinds: list[str] = []
+
+    def is_kind_expr(expr: ast.expr) -> bool:
+        if isinstance(expr, ast.Attribute) and expr.attr == "kind":
+            return True
+        if isinstance(expr, ast.Name) and expr.id == "kind":
+            return True
+        if isinstance(expr, ast.Subscript):
+            return const_str(expr.slice) == "kind"
+        if isinstance(expr, ast.Call) and isinstance(expr.func, ast.Attribute):
+            if expr.func.attr == "get" and expr.args:
+                return const_str(expr.args[0]) == "kind"
+        return False
+
+    for op, comp in zip(node.ops, node.comparators):
+        if isinstance(op, (ast.Eq, ast.NotEq)) and is_kind_expr(left):
+            s = const_str(comp)
+            if s is not None:
+                kinds.append(s)
+        elif isinstance(op, (ast.In, ast.NotIn)):
+            if is_kind_expr(left):
+                kinds.extend(const_strs(comp))
+            else:
+                # "some_kind" in kinds / in _RANK_INSTANTS
+                s = const_str(left)
+                target = (comp.id if isinstance(comp, ast.Name)
+                          else comp.attr if isinstance(comp, ast.Attribute)
+                          else "")
+                if s is not None and (_kindish_name(target)
+                                      or "instant" in target.lower()):
+                    kinds.append(s)
+        left = comp
+    return kinds
+
+
+def collect_consumed(files: list[Path], root: Path) -> list[Occurrence]:
+    out: list[Occurrence] = []
+    for path in files:
+        tree = parse_python(path)
+        if tree is None:
+            continue
+        rpath = rel(path, root)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Compare):
+                for s in _compare_consumptions(node):
+                    out.append((rpath, node.lineno, s))
+            elif isinstance(node, ast.Assign) and isinstance(node.value,
+                                                             ast.Set):
+                for t in node.targets:
+                    name = t.id if isinstance(t, ast.Name) else ""
+                    if name.isupper() and ("KIND" in name
+                                           or "INSTANT" in name):
+                        for elt in node.value.elts:
+                            s = const_str(elt)
+                            if s is not None:
+                                out.append((rpath, elt.lineno, s))
+    return [(p, ln, s) for p, ln, s in out if len(s) >= 2]
+
+
+def check_event_kinds(
+    kinds: dict[str, int],
+    emitted: list[Occurrence],
+    consumed: list[Occurrence],
+    local_emitted: list[Occurrence] | None = None,
+    events_py_rel: str = "rabit_tpu/obs/events.py",
+) -> list[Finding]:
+    """``emitted`` is the product-code emission set (checked against the
+    registry and counted as real producers); ``local_emitted`` are
+    emissions found in consumer-only files (tests minting fixture events)
+    — they exempt same-file consumption but never satisfy a product
+    consumer or the registry's unused rule."""
+    findings: list[Finding] = []
+    emitted_kinds = {s for _, _, s in emitted}
+    emitted_by_file: dict[str, set[str]] = {}
+    for p, _, s in list(emitted) + list(local_emitted or []):
+        emitted_by_file.setdefault(p, set()).add(s)
+
+    for p, ln, s in emitted:
+        if s not in kinds:
+            findings.append(Finding(
+                RULE_UNREGISTERED, p, ln,
+                f"event kind {s!r} is emitted but not declared in "
+                f"obs.events.KINDS — consumers cannot rely on it",
+                token=f"emit:{s}"))
+
+    seen_consumed: set[tuple[str, str]] = set()
+    for p, ln, s in consumed:
+        local = emitted_by_file.get(p, set())
+        if s in local:
+            continue  # same-file fixture kind (tests minting private rings)
+        if (p, s) in seen_consumed:
+            continue
+        seen_consumed.add((p, s))
+        if s not in kinds:
+            findings.append(Finding(
+                RULE_UNREGISTERED, p, ln,
+                f"consumer matches event kind {s!r} which is not declared "
+                f"in obs.events.KINDS (typo or rename drift?)",
+                token=f"consume:{s}"))
+        elif s not in emitted_kinds:
+            findings.append(Finding(
+                RULE_NEVER_EMITTED, p, ln,
+                f"consumer matches event kind {s!r} but nothing emits it — "
+                f"this match arm is dead and its signal is silently gone",
+                token=f"consume:{s}"))
+
+    for s, ln in sorted(kinds.items()):
+        if s not in emitted_kinds:
+            findings.append(Finding(
+                RULE_UNUSED, events_py_rel, ln,
+                f"KINDS entry {s!r} has no emitter anywhere — stale "
+                f"registry entry or deleted producer",
+                token=f"registered:{s}"))
+    return findings

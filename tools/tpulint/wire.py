@@ -1,0 +1,212 @@
+"""Wire-protocol symmetry: CMD/MAGIC values, handler coverage, struct use.
+
+The tracker protocol has two independent client implementations — Python
+(``rabit_tpu/tracker/protocol.py``) and C++ (``native/src/comm.h``/
+``comm.cc``) — plus one server (``rabit_tpu/tracker/tracker.py``).  The
+constants are re-declared on each side, so nothing but convention keeps
+them equal; a value skew or a command the server never branches on is a
+hang at bootstrap, not an error message.  Three invariants:
+
+* ``wire-cmd-mismatch`` — a ``CMD_*``/``MAGIC_*`` constant whose value
+  differs between protocol.py and comm.h (``kCmdStart`` ↔ ``CMD_START``,
+  ``kMagicHello`` ↔ ``MAGIC_HELLO``), or a native constant with no
+  Python counterpart at all;
+* ``wire-cmd-unhandled`` — a ``CMD_*`` defined in protocol.py that the
+  tracker's connection handler never references: a client can send it,
+  the server falls through, the client blocks on a reply forever;
+* ``wire-struct-oneway`` — a ``struct`` format (``struct.Struct`` binding
+  or direct ``struct.pack``/``unpack``) used only on the pack side or
+  only on the unpack side across the scanned files — the signature of a
+  one-sided format change tearing the frame layout.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+
+from tools.tpulint.core import Finding, const_str, parse_python, rel
+
+RULE_MISMATCH = "wire-cmd-mismatch"
+RULE_UNHANDLED = "wire-cmd-unhandled"
+RULE_ONEWAY = "wire-struct-oneway"
+
+_NATIVE_CONST_RE = re.compile(
+    r"k(Cmd|Magic)([A-Za-z0-9]+)\s*=\s*(0[xX][0-9a-fA-F]+|\d+)")
+
+
+def python_wire_consts(protocol_py: Path) -> dict[str, tuple[int, int]]:
+    """NAME -> (value, line) for module-level CMD_*/MAGIC_* int consts."""
+    tree = parse_python(protocol_py)
+    out: dict[str, tuple[int, int]] = {}
+    if tree is None:
+        return out
+    for node in tree.body:
+        if not isinstance(node, ast.Assign):
+            continue
+        if not (isinstance(node.value, ast.Constant)
+                and isinstance(node.value.value, int)):
+            continue
+        for t in node.targets:
+            if isinstance(t, ast.Name) and (t.id.startswith("CMD_")
+                                            or t.id.startswith("MAGIC_")):
+                out[t.id] = (node.value.value, node.lineno)
+    return out
+
+
+def _camel_to_const(prefix: str, camel: str) -> str:
+    snake = re.sub(r"(?<!^)(?=[A-Z0-9])", "_", camel).upper()
+    return f"{prefix}_{snake}"
+
+
+def native_wire_consts(comm_h: Path) -> dict[str, tuple[int, int]]:
+    """Python-style NAME -> (value, line) parsed from comm.h's kCmd*/
+    kMagic* constexprs."""
+    out: dict[str, tuple[int, int]] = {}
+    try:
+        text = comm_h.read_text(encoding="utf-8", errors="replace")
+    except OSError:
+        return out
+    for i, line in enumerate(text.splitlines(), 1):
+        for m in _NATIVE_CONST_RE.finditer(line):
+            prefix = "CMD" if m.group(1) == "Cmd" else "MAGIC"
+            name = _camel_to_const(prefix, m.group(2))
+            out[name] = (int(m.group(3), 0), i)
+    return out
+
+
+def referenced_cmds(path: Path) -> set[str]:
+    """CMD_* names referenced anywhere in a Python file (``P.CMD_X`` or
+    bare ``CMD_X``)."""
+    tree = parse_python(path)
+    refs: set[str] = set()
+    if tree is None:
+        return refs
+    for node in ast.walk(tree):
+        name = None
+        if isinstance(node, ast.Attribute):
+            name = node.attr
+        elif isinstance(node, ast.Name):
+            name = node.id
+        if name and name.startswith("CMD_"):
+            refs.add(name)
+    return refs
+
+
+def _struct_uses(files: list[Path],
+                 root: Path) -> dict[str, dict[str, list[tuple[str, int]]]]:
+    """fmt -> {"pack": [(relpath, line)...], "unpack": [...]}.
+
+    Tracks both ``NAME = struct.Struct("<fmt>")`` bindings (attributing
+    every later ``NAME.pack``/``NAME.unpack*`` to that format) and direct
+    ``struct.pack("<fmt>", ...)``/``struct.unpack*("<fmt>", ...)``
+    calls."""
+    uses: dict[str, dict[str, list[tuple[str, int]]]] = {}
+    bindings: dict[tuple[str, str], str] = {}  # (relpath, NAME) -> fmt
+
+    def note(fmt: str, side: str, where: tuple[str, int]) -> None:
+        uses.setdefault(fmt, {"pack": [], "unpack": []})[side].append(where)
+
+    parsed: list[tuple[str, ast.Module]] = []
+    for path in files:
+        tree = parse_python(path)
+        if tree is None:
+            continue
+        rpath = rel(path, root)
+        parsed.append((rpath, tree))
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Assign):
+                continue
+            call = node.value
+            if (isinstance(call, ast.Call)
+                    and isinstance(call.func, ast.Attribute)
+                    and call.func.attr == "Struct"
+                    and call.args):
+                fmt = const_str(call.args[0])
+                if fmt is None:
+                    continue
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        bindings[(rpath, t.id)] = fmt
+                        uses.setdefault(fmt, {"pack": [], "unpack": []})
+
+    for rpath, tree in parsed:
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)):
+                continue
+            attr = node.func.attr
+            side = ("pack" if attr in ("pack", "pack_into")
+                    else "unpack" if attr in ("unpack", "unpack_from")
+                    else None)
+            if side is None:
+                continue
+            base = node.func.value
+            if isinstance(base, ast.Name):
+                if base.id == "struct" and node.args:
+                    fmt = const_str(node.args[0])
+                    if fmt is not None:
+                        note(fmt, side, (rpath, node.lineno))
+                else:
+                    fmt = bindings.get((rpath, base.id))
+                    if fmt is not None:
+                        note(fmt, side, (rpath, node.lineno))
+    return uses
+
+
+def check_wire(
+    protocol_py: Path,
+    tracker_py: Path,
+    comm_h: Path,
+    struct_files: list[Path],
+    root: Path,
+) -> list[Finding]:
+    findings: list[Finding] = []
+    py_consts = python_wire_consts(protocol_py)
+    nat_consts = native_wire_consts(comm_h)
+    proto_rel = rel(protocol_py, root)
+    comm_rel = rel(comm_h, root)
+
+    for name, (nval, nline) in sorted(nat_consts.items()):
+        if name not in py_consts:
+            findings.append(Finding(
+                RULE_MISMATCH, comm_rel, nline,
+                f"native constant {name} (= {nval}) has no counterpart in "
+                f"{proto_rel}",
+                token=f"native-only:{name}"))
+        elif py_consts[name][0] != nval:
+            findings.append(Finding(
+                RULE_MISMATCH, proto_rel, py_consts[name][1],
+                f"{name} = {py_consts[name][0]} in {proto_rel} but "
+                f"{nval} in {comm_rel} — the two clients speak different "
+                f"wire values",
+                token=f"value:{name}"))
+
+    handled = referenced_cmds(tracker_py)
+    tracker_rel = rel(tracker_py, root)
+    for name, (_val, line) in sorted(py_consts.items()):
+        if name.startswith("CMD_") and name not in handled:
+            findings.append(Finding(
+                RULE_UNHANDLED, proto_rel, line,
+                f"{name} is defined in the protocol but {tracker_rel} "
+                f"never references it — a client sending it blocks on a "
+                f"reply that never comes",
+                token=name))
+
+    for fmt, sides in sorted(_struct_uses(struct_files, root).items()):
+        if sides["pack"] and not sides["unpack"]:
+            p, ln = sides["pack"][0]
+            findings.append(Finding(
+                RULE_ONEWAY, p, ln,
+                f"struct format {fmt!r} is packed here but never unpacked "
+                f"anywhere in the protocol surface",
+                token=f"pack:{fmt}"))
+        elif sides["unpack"] and not sides["pack"]:
+            p, ln = sides["unpack"][0]
+            findings.append(Finding(
+                RULE_ONEWAY, p, ln,
+                f"struct format {fmt!r} is unpacked here but never packed "
+                f"anywhere in the protocol surface",
+                token=f"unpack:{fmt}"))
+    return findings
