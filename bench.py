@@ -62,6 +62,17 @@ FIRST_ATTEMPT_CAP = 360.0  # healthy three-config run ≈250s (see
                            # lands, so a kill mid-third-race only loses
                            # the final-pass decision, never the number
 CPU_CHILD_TIMEOUT = 90.0
+# Codec ablation (ISSUE 5): one CPU child times the same hist rounds per
+# wire codec (f32 vs bf16x2 vs i8x2 vs i8) and reports rounds/sec +
+# allreduce raw/wire bytes — the compression trajectory BENCH_r06 carries.
+# Its elapsed time is deducted from the TPU budget (floored) so the total
+# wall stays inside the driver envelope; RABIT_BENCH_CODEC_ABLATION=0
+# skips it.
+CODEC_ABLATION = os.environ.get("RABIT_BENCH_CODEC_ABLATION", "1") != "0"
+CODEC_ROWS = int(os.environ.get("RABIT_BENCH_CODEC_ROWS", "150000"))
+CODEC_ROUNDS = 2
+CODEC_CHILD_TIMEOUT = 210.0
+CODECS_RACED = ("identity", "bf16x2", "i8x2", "i8")
 
 
 def log(msg):
@@ -229,6 +240,101 @@ def device_worker(n_rows, n_rounds, force_cpu):
                 "keeping xla-final")
 
 
+def codec_worker(n_rows, n_rounds):
+    """Child (forced CPU): time the hook-based hist boosting round once
+    per wire codec and print one JSON line per codec.  All codecs share
+    one process so the eager compute path is identical; only the
+    allreduce codec changes between runs."""
+    from rabit_tpu._platform import force_cpu_platform
+
+    force_cpu_platform(1)
+
+    import jax.numpy as jnp
+
+    import rabit_tpu as rt
+    from rabit_tpu.models import gbdt
+
+    xb, y = make_data(n_rows)
+    log(f"codec worker: {n_rows} rows x {N_FEATURES} feats, "
+        f"{n_rounds} timed rounds per codec")
+    rt.init([], rabit_compress_min_bytes=1)
+    cfg = gbdt.GBDTConfig(
+        n_features=N_FEATURES, n_trees=n_rounds + 1, depth=DEPTH,
+        n_bins=N_BINS, learning_rate=LR, reg_lambda=LAM,
+    )
+    xb_d, y_d = jnp.asarray(xb), jnp.asarray(y)
+    f32_line = None
+    for codec in CODECS_RACED:
+        arg = None if codec == "identity" else codec
+
+        def hook(hist):
+            return jnp.asarray(rt.allreduce(np.asarray(hist), rt.SUM,
+                                            codec=arg))
+
+        hist_fn = lambda xb_, g, h, node, nn, nb: hook(
+            gbdt.node_histograms(xb_, g, h, node, nn, nb))
+        state = gbdt.init_state(cfg, n_rows)
+        state = gbdt.train_round(state, xb_d, y_d, cfg, hist_fn, hook)  # warm
+        rt.reset_collective_stats()
+        t0 = time.perf_counter()
+        for _ in range(n_rounds):
+            state = gbdt.train_round(state, xb_d, y_d, cfg, hist_fn, hook)
+        np.asarray(state.margin)  # fence
+        dt = (time.perf_counter() - t0) / n_rounds
+        reg = rt.collective_stats().registry.snapshot()
+        raw = reg["ops"]["allreduce"]["nbytes"]
+        wire = reg["counters"].get("compress_wire_bytes_total", 0) or raw
+        acc = float(np.mean((np.asarray(state.margin) > 0) == y))
+        line = {
+            "codec": "f32" if codec == "identity" else codec,
+            "rounds_per_sec": round(1.0 / dt, 4),
+            "allreduce_raw_bytes": int(raw),
+            "allreduce_wire_bytes": int(wire),
+            "accuracy": round(acc, 5),
+        }
+        if f32_line is None:
+            f32_line = line
+        line["bytes_reduction_vs_f32"] = round(
+            f32_line["allreduce_wire_bytes"] / wire, 3)
+        line["rounds_per_sec_vs_f32"] = round(
+            line["rounds_per_sec"] / f32_line["rounds_per_sec"], 3)
+        log(f"codec {line['codec']}: {line['rounds_per_sec']:.3f} rounds/s, "
+            f"{raw}->{wire} B ({line['bytes_reduction_vs_f32']}x)")
+        print(json.dumps(line), flush=True)
+    rt.finalize()
+
+
+def run_codec_ablation(timeout=CODEC_CHILD_TIMEOUT):
+    """Run the codec child; returns the per-codec JSON lines (possibly
+    partial on timeout — each line lands the moment it is measured)."""
+    cmd = [sys.executable, os.path.abspath(__file__), "--codec-worker",
+           str(CODEC_ROWS), str(CODEC_ROUNDS)]
+    try:
+        r = subprocess.run(cmd, timeout=timeout, capture_output=True,
+                           text=True)
+        stdout, stderr, rc = r.stdout, r.stderr, r.returncode
+    except subprocess.TimeoutExpired as te:
+        to_text = lambda v: (v.decode(errors="replace")
+                             if isinstance(v, bytes) else (v or ""))
+        stdout, stderr, rc = to_text(te.stdout), to_text(te.stderr), None
+        log(f"codec ablation child timed out after {timeout:.0f}s; "
+            "keeping the lines it already measured")
+    for line in stderr.splitlines():
+        print(line, file=sys.stderr, flush=True)
+    if rc not in (0, None):
+        tail = stderr.strip().splitlines()[-3:]
+        log(f"codec ablation child rc={rc}: {' | '.join(tail)}")
+    lines = []
+    for line in stdout.strip().splitlines():
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(rec, dict) and "codec" in rec:
+            lines.append(rec)
+    return lines
+
+
 def probe_device(timeout=45.0) -> bool:
     """Fast TPU liveness check in a throwaway child: a wedged axon tunnel
     hangs at backend init (holding jax's lock forever), and burning the
@@ -288,7 +394,7 @@ def run_child(n_rows, n_rounds, force_cpu, timeout):
     return None
 
 
-def try_tpu_within_budget():
+def try_tpu_within_budget(budget=None):
     """Spend the full TPU wall budget attempting the chip.
 
     Returns the child's result dict, or None if the budget expired without
@@ -302,7 +408,7 @@ def try_tpu_within_budget():
     """
     # Anchor at ENTRY, not process start: the ~2s numpy baseline measured
     # before this must not be charged against the chip's budget.
-    deadline = time.time() + TPU_WALL_BUDGET
+    deadline = time.time() + (TPU_WALL_BUDGET if budget is None else budget)
     remaining = lambda: deadline - time.time()
     attempt = 0
     while remaining() > 30:
@@ -363,7 +469,21 @@ def main():
     # and flatter vs_baseline).
     baseline_1m = bench_cpu_scaled(N_ROWS)
     log(f"numpy baseline: {baseline_1m * 1e3:.1f} ms/round at {N_ROWS} rows")
-    res = try_tpu_within_budget()
+    codec_lines = []
+    tpu_budget = TPU_WALL_BUDGET
+    if CODEC_ABLATION:
+        # CPU-only, runs BEFORE the chip attempts; its wall comes out of
+        # the TPU budget (floored at 300s — still enough for one full
+        # three-config chip run) so the driver envelope is unchanged.
+        t_abl = time.time()
+        codec_lines = run_codec_ablation()
+        # Floor so the chip still gets one full three-config attempt — but
+        # never raise a deliberately small operator-set budget.
+        tpu_budget = max(TPU_WALL_BUDGET - (time.time() - t_abl),
+                         min(TPU_WALL_BUDGET, 300.0))
+        log(f"codec ablation: {len(codec_lines)} line(s); "
+            f"TPU budget now {tpu_budget:.0f}s")
+    res = try_tpu_within_budget(tpu_budget)
     n_rows = N_ROWS
     if not isinstance(res, dict):
         # Forced-CPU fallback: smaller problem so the jitted round fits the
@@ -386,6 +506,8 @@ def main():
         cap = parked_tpu_capture()
         if cap is not None:
             rec["last_tpu_capture"] = cap
+        if codec_lines:
+            rec["codec_ablation"] = codec_lines
         print(json.dumps(rec), flush=True)
         return
     device_time = res["device_time"]
@@ -425,11 +547,20 @@ def main():
         cap = parked_tpu_capture()
         if cap is not None:
             rec["last_tpu_capture"] = cap
+    if codec_lines:
+        rec["codec_ablation"] = codec_lines
     print(json.dumps(rec), flush=True)
 
 
 if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "--device-worker":
         device_worker(int(sys.argv[2]), int(sys.argv[3]), bool(int(sys.argv[4])))
+    elif len(sys.argv) > 1 and sys.argv[1] == "--codec-worker":
+        codec_worker(int(sys.argv[2]), int(sys.argv[3]))
+    elif len(sys.argv) > 1 and sys.argv[1] == "--codec-ablation":
+        # Standalone trajectory: one JSON line per codec on stdout (the
+        # same lines main() embeds under "codec_ablation").
+        for rec in run_codec_ablation():
+            print(json.dumps(rec), flush=True)
     else:
         main()

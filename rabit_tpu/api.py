@@ -21,7 +21,7 @@ from typing import Any, Callable
 
 import numpy as np
 
-from rabit_tpu import obs
+from rabit_tpu import compress, obs
 from rabit_tpu.config import Config
 from rabit_tpu.engine import create_engine
 from rabit_tpu.engine.base import MAX, MIN, SUM, BITOR, DTYPE_ENUM, Engine
@@ -110,6 +110,18 @@ def init(args: list[str] | None = None, **overrides: Any) -> None:
     # Observability wiring: flight recorder capacity, hang/SIGTERM dump
     # paths (RABIT_OBS_DIR), metric shipping identity (see rabit_tpu.obs).
     obs.configure(cfg, rank=_engine.get_rank())
+    # Compression policy (rabit_tpu/compress, doc/compression.md): the
+    # rabit_compress_* keys resolve once per init; the resolved policy is
+    # recorded so a cross-rank config skew is visible in the dumps.
+    pol = compress.configure(cfg)
+    obs.record_event(
+        "compress_policy",
+        allreduce=pol.allreduce or "identity",
+        min_bytes=pol.min_bytes,
+        wire_deflate=pol.wire_deflate,
+        broadcast=pol.broadcast or "identity",
+        checkpoint=pol.checkpoint or "identity",
+    )
     obs.record_event(
         "engine_ready",
         engine=type(_engine).__name__,
@@ -122,7 +134,8 @@ def init(args: list[str] | None = None, **overrides: Any) -> None:
     if ckpt_dir and ckpt_dir != "NULL":
         from rabit_tpu.store import CheckpointStore
 
-        _ckpt_store = CheckpointStore(ckpt_dir, _engine.get_rank())
+        _ckpt_store = CheckpointStore(ckpt_dir, _engine.get_rank(),
+                                      codec=pol.checkpoint)
     else:
         _ckpt_store = None
 
@@ -140,6 +153,7 @@ def finalize() -> None:
         # rabit_trace_exit=1: leave this life's ring as a -exit flight dump
         # so the cross-rank trace merger has per-rank evidence of CLEAN runs
         obs.dump_final()
+    compress.reset()
     _ckpt_store = None
     _ckpt_base = 0
 
@@ -169,36 +183,68 @@ def get_processor_name() -> str:
 
 def broadcast(data: Any, root: int) -> Any:
     """Broadcast any picklable object from ``root``.  Two-phase
-    length-then-payload, like the reference (python/rabit.py:171-206)."""
+    length-then-payload, like the reference (python/rabit.py:171-206).
+
+    With ``rabit_compress_broadcast`` configured (e.g. ``zlib``), the
+    pickled payload crosses the wire compressed behind a one-byte codec
+    frame; payloads under ``rabit_compress_min_bytes`` ride as identity.
+    The policy comes from the shared job config, so every rank frames and
+    deframes symmetrically."""
     engine = _get_engine()
     key = _caller_key()
     rank = engine.get_rank()
+    pol = compress.policy()
+    bcodec = compress.get_codec(pol.broadcast) if pol.broadcast else None
     payload = None
     if rank == root:
         if data is None:
             raise ValueError("need to pass in data when broadcasting")
         payload = pickle.dumps(data, protocol=pickle.HIGHEST_PROTOCOL)
+        if bcodec is not None:
+            if len(payload) >= pol.min_bytes:
+                wire = bcodec.encode_bytes(payload)
+                compress.observe(bcodec.name, raw=len(payload),
+                                 wire=len(wire))
+                payload = bytes([bcodec.codec_id]) + wire
+            else:
+                payload = bytes([0]) + payload  # identity frame
     # Same timed/evented path as allreduce/allgather; a non-root only
     # learns the payload length from the wire, so the span's byte count is
     # set inside the window.
     with obs.collective(
-        "broadcast", len(payload) if payload is not None else 0, cache_key=key
+        "broadcast", len(payload) if payload is not None else 0,
+        cache_key=key, codec=bcodec.name if bcodec is not None else None,
     ) as span:
         out = engine.broadcast(payload, root, cache_key=key)
         span.nbytes = (len(payload) if payload is not None
                        else len(out) if out else 0)
-    return data if rank == root else pickle.loads(out)
+    if rank == root:
+        return data
+    if bcodec is not None:
+        out = bytes(out)
+        out = compress.get_codec_by_id(out[0]).decode_bytes(out[1:])
+    return pickle.loads(out)
 
 
 def allreduce(
     data: np.ndarray,
     op: int,
     prepare_fun: Callable[[np.ndarray], None] | None = None,
+    codec: str | None = None,
 ) -> np.ndarray:
     """Allreduce a numpy array.  ``op`` is one of MAX/MIN/SUM/BITOR.
     ``prepare_fun(data)`` is called lazily right before the reduction and is
     skipped when the result is recovered from a peer's replay buffer
-    (reference semantics, python/rabit.py:220-263)."""
+    (reference semantics, python/rabit.py:220-263).
+
+    ``codec`` selects a wire codec (rabit_tpu.compress; doc/compression.md)
+    for this call: the payload crosses the engine encoded and every rank
+    decodes/folds identically, trading the codec's documented error bound
+    for wire bytes.  ``None`` applies the ``rabit_compress_allreduce``
+    policy (float32, non-BITOR payloads of at least
+    ``rabit_compress_min_bytes``); ``"identity"`` forces the exact path.
+    On the compressed path ``prepare_fun`` runs eagerly — its output feeds
+    the encoder."""
     if not isinstance(data, np.ndarray):
         raise TypeError("allreduce only takes numpy ndarrays")
     if data.dtype not in DTYPE_ENUM:
@@ -214,14 +260,22 @@ def allreduce(
             orig_prepare(data)
             buf_view[...] = np.ascontiguousarray(data).reshape(-1)
 
+    c = compress.resolve(codec, buf.dtype, op, buf.nbytes)
     # NOTE: the timed window includes a lazy prepare_fun's execution (it
     # runs inside the engine, interleaved with recovery decisions), so
     # expensive preparation shows up as allreduce latency in the stats.
     key = _caller_key()
-    with obs.collective("allreduce", buf.nbytes, cache_key=key):
-        out = _get_engine().allreduce(
-            buf, op, prepare_fun=prepare_fun, cache_key=key
-        )
+    if c is None:
+        with obs.collective("allreduce", buf.nbytes, cache_key=key):
+            out = _get_engine().allreduce(
+                buf, op, prepare_fun=prepare_fun, cache_key=key
+            )
+    else:
+        with obs.collective("allreduce", buf.nbytes, cache_key=key,
+                            codec=c.name):
+            out = _get_engine().allreduce_compressed(
+                buf, op, c, prepare_fun=prepare_fun, cache_key=key
+            )
     return np.asarray(out).reshape(shape)
 
 
@@ -283,10 +337,19 @@ def _disk_resume():
             np.array([engine.get_rank() if have else world], np.int64), MIN,
             cache_key="rabit_tpu.store::root")[0]
     )
-    gblob = engine.broadcast(
-        _ckpt_store.load_global(vmax) if engine.get_rank() == root else None,
+    # The recovery/bootstrap blob crosses the wire zlib-compressed (both
+    # ends run this same code, so no frame negotiation is needed; the
+    # holder's own broadcast-return decompresses identically).
+    zcodec = compress.get_codec("zlib")
+    wireblob = engine.broadcast(
+        zcodec.encode_bytes(_ckpt_store.load_global(vmax))
+        if engine.get_rank() == root else None,
         root, cache_key="rabit_tpu.store::blob",
     )
+    gblob = zcodec.decode_bytes(bytes(wireblob))
+    compress.observe(zcodec.name, raw=len(gblob), wire=len(wireblob))
+    obs.record_event("recovery_blob_compressed", raw=len(gblob),
+                     wire=len(wireblob), version=vmax)
     lblob = _ckpt_store.load_local(vmax) if have else None
     return vmax, bytes(gblob), lblob
 

@@ -1,0 +1,447 @@
+"""Codec implementations — numeric plane codecs and the byte-blob codec.
+
+Every numeric codec obeys one contract (doc/compression.md):
+
+* ``encode`` is **deterministic** (same bytes for the same input, every
+  time, on every rank — no timestamps, no dict-order, a pinned deflate
+  level) and **rank-symmetric** (the function does not depend on rank);
+* ``decode(encode(x))`` error is **bounded and documented** per codec
+  (the ``error_bound`` field, asserted by tests/test_compress.py);
+* every codec has a **pure-numpy reference** (``encode``/``decode``) and
+  an **in-graph JAX path** (``jax_encode``/``jax_decode``) producing the
+  same plane layout, so the XLA engine quantizes/dequantizes on-device
+  and a fused flush stays one device collective.
+
+Plane layouts (the byte strings ``encode`` returns, before the transport's
+optional deflate stage):
+
+* ``identity`` — the raw f32 bytes.
+* ``bf16``     — one uint16 plane: the top 16 bits of each f32, rounded
+  to nearest-even (error ~2^-8 relative per element).
+* ``bf16x2``   — two uint16 planes hi/lo with ``lo = x - f32(hi)`` (the
+  same split as ops/boost.py ``_encode_bf16``; error ~2^-16 relative).
+  Same byte count as f32 — its value is near-exactness plus whatever the
+  deflate stage recovers, not raw width.
+* ``i8``       — one int8 plane + one f32 scale per 256-element block:
+  ``a = round(clip(x) * 127)`` against the block max (error ~2^-8 of the
+  block max; ~3.9x before deflate).
+* ``i8x2``     — two int8 planes + f32 block scales, the exact fixed-point
+  split of ops/boost.py ``_encode_i8``: ``a = round(x*64)``,
+  ``b = round((x - a/64) * 8192)`` (error ~2^-14 of the block max).
+
+Two-plane codecs concatenate their planes into ONE byte string (plane 0,
+plane 1, scales), so a fused buffer's planes ride together — one wire
+payload, one device array, one collective.
+
+Non-finite inputs are saturated deterministically before quantization
+(``nan -> 0``, ``±inf -> ±block max``) in both the numpy and JAX paths, so
+a stray inf cannot turn into undefined int8 casts that differ by backend.
+"""
+
+from __future__ import annotations
+
+import zlib
+
+import numpy as np
+
+#: Elements per scale block of the block-scaled int8 codecs (matches the
+#: MXU encoder's effective granularity and parallel/collectives.py).
+BLOCK = 256
+
+#: Smallest normal f32 — the all-zero-block guard of ops/boost.py
+#: ``_encode_i8`` (1/tiny stays finite, tiny-but-nonzero blocks survive).
+_TINY = np.float32(1.1754944e-38)
+
+#: Pinned deflate level for every zlib use in this package.  Level 1:
+#: within ~1% of level 6 on histogram planes (measured) at ~3x the
+#: throughput, and the level is part of the determinism contract — all
+#: ranks must produce identical bytes for identical input.
+DEFLATE_LEVEL = 1
+
+
+def _blocks(n: int) -> int:
+    return -(-n // BLOCK)
+
+
+def _pad_blocks_np(v: np.ndarray) -> np.ndarray:
+    """[n] f32 -> [nblocks, BLOCK] f32, zero padded."""
+    n = v.size
+    npad = _blocks(n) * BLOCK
+    if npad != n:
+        out = np.zeros(npad, np.float32)
+        out[:n] = v
+        v = out
+    return v.reshape(-1, BLOCK)
+
+
+def _block_scale_np(vb: np.ndarray) -> np.ndarray:
+    amax = np.max(np.abs(np.where(np.isfinite(vb), vb, 0.0)), axis=1,
+                  keepdims=True).astype(np.float32)
+    return np.maximum(amax, _TINY)
+
+
+def _saturate_np(x: np.ndarray) -> np.ndarray:
+    return np.nan_to_num(x, nan=0.0, posinf=1.0, neginf=-1.0).astype(np.float32)
+
+
+def _f32_to_bf16_np(arr: np.ndarray) -> np.ndarray:
+    """Round-to-nearest-even truncation to the top 16 bits (numpy has no
+    bfloat16; the plane is carried as uint16)."""
+    u = np.ascontiguousarray(arr, np.float32).view(np.uint32)
+    bias = np.uint32(0x7FFF) + ((u >> np.uint32(16)) & np.uint32(1))
+    return ((u + bias) >> np.uint32(16)).astype(np.uint16)
+
+
+def _bf16_to_f32_np(bits: np.ndarray) -> np.ndarray:
+    return (bits.astype(np.uint32) << np.uint32(16)).view(np.float32)
+
+
+class Codec:
+    """Base class; also the registry row (name, wire id, error bound)."""
+
+    #: registry name
+    name: str = ""
+    #: stable 1-byte wire/frame id (store frames, transport headers)
+    codec_id: int = -1
+    #: "numeric" (f32 arrays) or "bytes" (opaque blobs)
+    kind: str = "numeric"
+    #: True when decode(encode(x)) == x exactly
+    lossless: bool = False
+    #: documented decode(encode(x)) error bound (doc/compression.md)
+    error_bound: str = ""
+    #: True when encode output length depends only on the input length —
+    #: equal-shape inputs on every rank then yield equal wire slices and
+    #: the transport needs no size-agreement preamble
+    fixed_size: bool = True
+
+    # -- numeric path (f32 arrays) -----------------------------------------
+
+    def encode(self, arr: np.ndarray) -> bytes:
+        raise NotImplementedError
+
+    def decode(self, blob: bytes, n: int) -> np.ndarray:
+        raise NotImplementedError
+
+    def roundtrip(self, arr: np.ndarray) -> np.ndarray:
+        """decode(encode(arr)), reshaped like ``arr`` — the reference lossy
+        round trip tests and closed-form self-checks fold with."""
+        flat = np.ascontiguousarray(arr, np.float32).reshape(-1)
+        return self.decode(self.encode(flat), flat.size).reshape(arr.shape)
+
+    def wire_len(self, n: int) -> int:
+        """Encoded byte count for an n-element f32 input (fixed-size
+        codecs only)."""
+        raise NotImplementedError
+
+    # -- in-graph JAX path (None => host-only codec) -----------------------
+
+    #: set False on codecs without a device path
+    has_jax: bool = True
+
+    def jax_encode(self, x):
+        """f32 [n] jnp array -> uint8 [wire_len(n)] jnp array, same plane
+        layout as ``encode`` (in-graph ops only)."""
+        raise NotImplementedError
+
+    def jax_decode(self, packed, n: int):
+        """uint8 [wire_len(n)] -> f32 [n] (in-graph ops only)."""
+        raise NotImplementedError
+
+    # -- byte path (blobs) -------------------------------------------------
+
+    def encode_bytes(self, blob: bytes) -> bytes:
+        raise NotImplementedError(f"codec {self.name!r} is not a byte codec")
+
+    def decode_bytes(self, blob: bytes) -> bytes:
+        raise NotImplementedError(f"codec {self.name!r} is not a byte codec")
+
+
+class IdentityCodec(Codec):
+    name = "identity"
+    codec_id = 0
+    lossless = True
+    error_bound = "exact"
+
+    def encode(self, arr):
+        return np.ascontiguousarray(arr, np.float32).tobytes()
+
+    def decode(self, blob, n):
+        return np.frombuffer(blob, np.float32, count=n).copy()
+
+    def wire_len(self, n):
+        return 4 * n
+
+    def jax_encode(self, x):
+        from jax import lax
+
+        return lax.bitcast_convert_type(x, np.uint8).reshape(-1)
+
+    def jax_decode(self, packed, n):
+        from jax import lax
+
+        return lax.bitcast_convert_type(packed.reshape(n, 4), np.float32)
+
+    def encode_bytes(self, blob):
+        return bytes(blob)
+
+    def decode_bytes(self, blob):
+        return bytes(blob)
+
+
+class ZlibCodec(Codec):
+    """Lossless byte-blob codec (checkpoint frames, recovery/bootstrap
+    blobs).  Deterministic at the pinned :data:`DEFLATE_LEVEL`."""
+
+    name = "zlib"
+    codec_id = 1
+    kind = "bytes"
+    lossless = True
+    error_bound = "exact"
+    fixed_size = False
+    has_jax = False
+
+    def encode_bytes(self, blob):
+        return zlib.compress(bytes(blob), DEFLATE_LEVEL)
+
+    def decode_bytes(self, blob):
+        return zlib.decompress(bytes(blob))
+
+
+class Bf16Codec(Codec):
+    name = "bf16"
+    codec_id = 2
+    error_bound = "~2^-8 relative per element"
+
+    def encode(self, arr):
+        return _f32_to_bf16_np(np.ascontiguousarray(arr, np.float32)).tobytes()
+
+    def decode(self, blob, n):
+        return _bf16_to_f32_np(np.frombuffer(blob, np.uint16, count=n))
+
+    def wire_len(self, n):
+        return 2 * n
+
+    def jax_encode(self, x):
+        import jax.numpy as jnp
+        from jax import lax
+
+        return lax.bitcast_convert_type(
+            lax.bitcast_convert_type(x.astype(jnp.bfloat16), np.uint16),
+            np.uint8).reshape(-1)
+
+    def jax_decode(self, packed, n):
+        import jax.numpy as jnp
+        from jax import lax
+
+        bits = lax.bitcast_convert_type(packed.reshape(n, 2), np.uint16)
+        return lax.bitcast_convert_type(bits, jnp.bfloat16).astype(jnp.float32)
+
+
+class Bf16x2Codec(Codec):
+    """Hi/lo two-plane bf16 (ops/boost.py ``_encode_bf16``): same byte
+    count as f32, near-exact; the deflate stage recovers real bytes from
+    the low-entropy hi plane."""
+
+    name = "bf16x2"
+    codec_id = 3
+    error_bound = "~2^-16 relative per element"
+
+    def encode(self, arr):
+        x = np.ascontiguousarray(arr, np.float32)
+        hi = _f32_to_bf16_np(x)
+        with np.errstate(invalid="ignore"):  # inf - inf: nan rides the lo plane
+            lo = _f32_to_bf16_np(x - _bf16_to_f32_np(hi))
+        return hi.tobytes() + lo.tobytes()
+
+    def decode(self, blob, n):
+        hi = np.frombuffer(blob, np.uint16, count=n)
+        lo = np.frombuffer(blob, np.uint16, count=n, offset=2 * n)
+        return _bf16_to_f32_np(hi) + _bf16_to_f32_np(lo)
+
+    def wire_len(self, n):
+        return 4 * n
+
+    def jax_encode(self, x):
+        import jax.numpy as jnp
+        from jax import lax
+
+        hi = x.astype(jnp.bfloat16)
+        lo = (x - hi.astype(jnp.float32)).astype(jnp.bfloat16)
+        as_u8 = lambda p: lax.bitcast_convert_type(
+            lax.bitcast_convert_type(p, np.uint16), np.uint8).reshape(-1)
+        return jnp.concatenate([as_u8(hi), as_u8(lo)])
+
+    def jax_decode(self, packed, n):
+        import jax.numpy as jnp
+        from jax import lax
+
+        def plane(off):
+            bits = lax.bitcast_convert_type(
+                lax.dynamic_slice_in_dim(packed, off, 2 * n).reshape(n, 2),
+                np.uint16)
+            return lax.bitcast_convert_type(bits, jnp.bfloat16).astype(
+                jnp.float32)
+
+        return plane(0) + plane(2 * n)
+
+
+class _BlockI8(Codec):
+    """Shared machinery of the block-scaled int8 codecs: planes are laid
+    out plane-major (plane 0 bytes, [plane 1 bytes,] f32 scales)."""
+
+    planes: int = 1
+
+    def wire_len(self, n):
+        nb = _blocks(n)
+        return self.planes * nb * BLOCK + 4 * nb
+
+    def _encode_planes_np(self, x: np.ndarray) -> list[np.ndarray]:
+        raise NotImplementedError
+
+    def _decode_planes_np(self, planes: list[np.ndarray],
+                          scale: np.ndarray) -> np.ndarray:
+        raise NotImplementedError
+
+    def encode(self, arr):
+        vb = _pad_blocks_np(np.ascontiguousarray(arr, np.float32).reshape(-1))
+        scale = _block_scale_np(vb)
+        x = _saturate_np(vb * (np.float32(1.0) / scale))
+        planes = self._encode_planes_np(x)
+        return (b"".join(p.astype(np.int8).tobytes() for p in planes)
+                + scale.astype(np.float32).tobytes())
+
+    def decode(self, blob, n):
+        nb = _blocks(n)
+        npad = nb * BLOCK
+        planes = [
+            np.frombuffer(blob, np.int8, count=npad, offset=i * npad)
+            .reshape(nb, BLOCK).astype(np.float32)
+            for i in range(self.planes)
+        ]
+        scale = np.frombuffer(blob, np.float32, count=nb,
+                              offset=self.planes * npad).reshape(nb, 1)
+        return self._decode_planes_np(planes, scale).reshape(-1)[:n]
+
+    # JAX mirrors of the numpy ops (bit-parity is asserted by tests)
+
+    def _jax_pad_blocks(self, x):
+        import jax.numpy as jnp
+
+        n = x.shape[0]
+        npad = _blocks(n) * BLOCK
+        if npad != n:
+            x = jnp.pad(x, (0, npad - n))
+        return x.reshape(-1, BLOCK)
+
+    def jax_encode(self, x):
+        import jax.numpy as jnp
+        from jax import lax
+
+        vb = self._jax_pad_blocks(x.astype(jnp.float32))
+        amax = jnp.max(jnp.abs(jnp.where(jnp.isfinite(vb), vb, 0.0)), axis=1,
+                       keepdims=True)
+        scale = jnp.maximum(amax, _TINY)
+        xb = jnp.nan_to_num(vb * (np.float32(1.0) / scale), nan=0.0,
+                            posinf=1.0, neginf=-1.0)
+        planes = self._encode_planes_jax(xb)
+        parts = [lax.bitcast_convert_type(p.astype(jnp.int8), np.uint8)
+                 .reshape(-1) for p in planes]
+        parts.append(lax.bitcast_convert_type(
+            scale.reshape(-1).astype(jnp.float32), np.uint8).reshape(-1))
+        return jnp.concatenate(parts)
+
+    def jax_decode(self, packed, n):
+        import jax.numpy as jnp
+        from jax import lax
+
+        nb = _blocks(n)
+        npad = nb * BLOCK
+        planes = [
+            lax.bitcast_convert_type(
+                lax.dynamic_slice_in_dim(packed, i * npad, npad),
+                np.int8).reshape(nb, BLOCK).astype(jnp.float32)
+            for i in range(self.planes)
+        ]
+        scale = lax.bitcast_convert_type(
+            lax.dynamic_slice_in_dim(packed, self.planes * npad, 4 * nb)
+            .reshape(nb, 4), np.float32).reshape(nb, 1)
+        return self._decode_planes_np(planes, scale).reshape(-1)[:n]
+
+    def _encode_planes_jax(self, x):
+        raise NotImplementedError
+
+
+class I8Codec(_BlockI8):
+    name = "i8"
+    codec_id = 4
+    planes = 1
+    error_bound = "~2^-8 of the block max (256-element blocks)"
+
+    def _encode_planes_np(self, x):
+        return [np.clip(np.round(x * np.float32(127.0)), -127, 127)]
+
+    def _encode_planes_jax(self, x):
+        import jax.numpy as jnp
+
+        return [jnp.clip(jnp.round(x * np.float32(127.0)), -127, 127)]
+
+    def _decode_planes_np(self, planes, scale):
+        return planes[0] * (scale * np.float32(1.0 / 127.0))
+
+
+class I8x2Codec(_BlockI8):
+    """The exact two-plane fixed-point split of ops/boost.py
+    ``_encode_i8``: ``a = round(x*64)`` (|a| <= 64), residual plane
+    ``b = round((x - a/64) * 8192)`` (|b| <= 65) — 14-bit fixed point,
+    error ~2^-14 of the block max."""
+
+    name = "i8x2"
+    codec_id = 5
+    planes = 2
+    error_bound = "~2^-14 of the block max (256-element blocks)"
+
+    def _encode_planes_np(self, x):
+        a = np.round(x * np.float32(64.0))
+        b = np.round((x - a * np.float32(1.0 / 64.0)) * np.float32(8192.0))
+        return [a, b]
+
+    def _encode_planes_jax(self, x):
+        import jax.numpy as jnp
+
+        a = jnp.round(x * np.float32(64.0))
+        b = jnp.round((x - a * np.float32(1.0 / 64.0)) * np.float32(8192.0))
+        return [a, b]
+
+    def _decode_planes_np(self, planes, scale):
+        hi, lo = planes
+        return (hi * np.float32(1.0 / 64.0)
+                + lo * np.float32(1.0 / 8192.0)) * scale
+
+
+#: The registry — name -> singleton codec instance.
+CODECS: dict[str, Codec] = {
+    c.name: c
+    for c in (IdentityCodec(), ZlibCodec(), Bf16Codec(), Bf16x2Codec(),
+              I8Codec(), I8x2Codec())
+}
+
+_BY_ID: dict[int, Codec] = {c.codec_id: c for c in CODECS.values()}
+
+
+def get_codec(name: str) -> Codec:
+    try:
+        return CODECS[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown codec {name!r}; registered: {sorted(CODECS)}"
+        ) from None
+
+
+def get_codec_by_id(codec_id: int) -> Codec:
+    try:
+        return _BY_ID[codec_id]
+    except KeyError:
+        raise ValueError(
+            f"unknown codec id {codec_id}; registered: "
+            f"{sorted((c.codec_id, c.name) for c in CODECS.values())}"
+        ) from None

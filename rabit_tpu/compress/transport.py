@@ -1,0 +1,148 @@
+"""Host-side compressed-allreduce transport and the codec metering hooks.
+
+The host transport is the pure-numpy reference path every engine gets for
+free (the XLA engine overrides it with an on-device fused path):
+
+1. ``prepare``d local contribution -> ``codec.encode`` -> optional deflate
+   stage -> an 8-byte frame header (codec id + flags + payload length);
+2. ONE engine allgather of the framed wire bytes (plus, only when the
+   deflate stage makes sizes rank-dependent, one tiny int64 MAX allreduce
+   agreeing on the padded slice size first — a fixed two-op sequence,
+   identical on every rank, so the robust engine's positional seqno/replay
+   contract is untouched);
+3. every rank decodes all ranks' planes and folds them in rank order with
+   the exact same numpy ops — so the delivered result is **bitwise
+   identical on every rank**, and :func:`reference_allreduce` reproduces
+   it in closed form for self-verifying workloads.
+
+Replay safety: the engine-level collectives carry the caller's cache_key
+(suffixed per sub-op); after a failure the robust engine replays the
+*gathered wire bytes* verbatim, and because ``decode`` and the fold are
+deterministic pure functions of those bytes, the decoded delivery is
+bitwise identical to the first one.  A cross-rank codec mismatch is caught
+by the frame header (``CodecMismatchError`` naming the ranks) instead of
+silently folding garbage.
+"""
+
+from __future__ import annotations
+
+import struct
+import time
+import zlib
+
+import numpy as np
+
+from rabit_tpu.compress.codecs import DEFLATE_LEVEL, Codec, get_codec
+from rabit_tpu.obs.metrics import GLOBAL_REGISTRY
+
+#: Wire frame prepended to every rank's allgather slice:
+#: codec id, flags, reserved, encoded payload length.
+FRAME = struct.Struct("<BBxxI")
+
+FLAG_DEFLATE = 0x01
+
+
+class CodecMismatchError(RuntimeError):
+    """Peers disagree on the collective's codec — config skew, not data."""
+
+
+def observe(codec_name: str, raw: int, wire: int,
+            encode_s: float | None = None,
+            decode_s: float | None = None) -> None:
+    """Record one compression event into the process metrics registry:
+    raw/wire byte counters plus per-codec ratio and latency histograms
+    (doc/observability.md, "Compression metrics")."""
+    reg = GLOBAL_REGISTRY
+    reg.counter("compress_raw_bytes_total").inc(int(raw))
+    reg.counter("compress_wire_bytes_total").inc(int(wire))
+    if wire > 0:
+        reg.histogram(f"compress_ratio_{codec_name}").observe(raw / wire)
+    if encode_s is not None:
+        reg.histogram(f"compress_encode_seconds_{codec_name}").observe(encode_s)
+    if decode_s is not None:
+        reg.histogram(f"compress_decode_seconds_{codec_name}").observe(decode_s)
+
+
+def encode_wire(codec: Codec, buf: np.ndarray, deflate: bool) -> bytes:
+    """Frame one rank's contribution: header + encoded planes, with the
+    lossless deflate stage applied when requested."""
+    enc = codec.encode(buf)
+    flags = 0
+    if deflate:
+        enc = zlib.compress(enc, DEFLATE_LEVEL)
+        flags |= FLAG_DEFLATE
+    return FRAME.pack(codec.codec_id, flags, len(enc)) + enc
+
+
+def decode_wire(codec: Codec, slice_bytes: bytes, n: int,
+                rank: int) -> np.ndarray:
+    """Inverse of :func:`encode_wire` for one rank's (possibly padded)
+    allgather slice; validates the frame's codec id."""
+    codec_id, flags, enc_len = FRAME.unpack_from(slice_bytes)
+    if codec_id != codec.codec_id:
+        raise CodecMismatchError(
+            f"compressed allreduce: rank {rank} sent codec id {codec_id}, "
+            f"this rank expects {codec.codec_id} ({codec.name!r}) — ranks "
+            f"disagree on rabit_compress_allreduce / the codec= argument"
+        )
+    enc = slice_bytes[FRAME.size:FRAME.size + enc_len]
+    if flags & FLAG_DEFLATE:
+        enc = zlib.decompress(enc)
+    return codec.decode(enc, n)
+
+
+def _fold(op: int, acc: np.ndarray | None, part: np.ndarray) -> np.ndarray:
+    from rabit_tpu.engine.base import numpy_reduce
+
+    if acc is None:
+        return np.array(part, copy=True)
+    return numpy_reduce(op, acc, part)
+
+
+def host_allreduce(engine, buf: np.ndarray, op: int, codec: Codec,
+                   cache_key: str | None = None,
+                   deflate: bool = True) -> np.ndarray:
+    """The default (numpy) compressed allreduce over any engine's
+    primitives; see the module docstring for the wire shape."""
+    from rabit_tpu.engine.base import MAX
+
+    n = buf.size
+    t0 = time.perf_counter()
+    payload = encode_wire(codec, buf, deflate)
+    enc_s = time.perf_counter() - t0
+    world = engine.get_world_size()
+    key = lambda suffix: None if cache_key is None else cache_key + suffix
+    if deflate and world > 1:
+        # Deflate makes wire sizes data-dependent; agree on the padded
+        # slice size first (same fixed two-op sequence on every rank).
+        nmax = int(engine.allreduce(
+            np.array([len(payload)], np.int64), MAX,
+            cache_key=key("#wiresz"))[0])
+    else:
+        nmax = len(payload)
+    wire = np.zeros(nmax, np.uint8)
+    wire[:len(payload)] = np.frombuffer(payload, np.uint8)
+    gathered = np.asarray(engine.allgather(wire, cache_key=key("#wire")))
+    parts = gathered.reshape(world, nmax)
+    t1 = time.perf_counter()
+    out: np.ndarray | None = None
+    for r in range(world):
+        out = _fold(op, out, decode_wire(codec, parts[r].tobytes(), n, r))
+    observe(codec.name, raw=buf.nbytes, wire=len(payload), encode_s=enc_s,
+            decode_s=time.perf_counter() - t1)
+    return out.astype(buf.dtype, copy=False)
+
+
+def reference_allreduce(contribs: list[np.ndarray], op: int,
+                        codec: str | Codec) -> np.ndarray:
+    """Closed-form mirror of :func:`host_allreduce`: fold every rank's
+    lossy round trip in rank order with the same numpy ops.  Self-verifying
+    workloads (tests/workers/recover_worker.py) compute their expected
+    values through this, so a compressed collective — first delivery OR
+    post-recovery replay — must match **bitwise**."""
+    c = codec if isinstance(codec, Codec) else get_codec(codec)
+    out: np.ndarray | None = None
+    for contrib in contribs:
+        flat = np.ascontiguousarray(contrib, np.float32).reshape(-1)
+        out = _fold(op, out, c.decode(c.encode(flat), flat.size))
+    return out.reshape(np.shape(contribs[0]))

@@ -74,6 +74,21 @@ DEFAULTS: dict[str, str] = {
     # also written here and a FRESH cluster resumes from the newest disk
     # version (whole-job preemption durability; rabit_tpu/store.py).
     "rabit_checkpoint_dir": "",
+    # Compressed collectives (rabit_tpu/compress, doc/compression.md).
+    # rabit_compress_allreduce: default codec for api.allreduce payloads
+    # (identity|bf16|bf16x2|i8|i8x2; empty = exact f32).  Applies only to
+    # float32 non-BITOR payloads of at least rabit_compress_min_bytes
+    # bytes; a per-call codec= argument always wins.
+    # rabit_compress_wire_deflate: lossless deflate stage on the host
+    # transport's wire bytes (the in-graph XLA path ships raw planes).
+    # rabit_compress_broadcast: byte codec (zlib) for api.broadcast
+    # payloads.  rabit_checkpoint_compress: codec byte of the durable
+    # store's frames (old frames stay readable; empty = uncompressed).
+    "rabit_compress_allreduce": "",
+    "rabit_compress_min_bytes": "1024",
+    "rabit_compress_wire_deflate": "1",
+    "rabit_compress_broadcast": "",
+    "rabit_checkpoint_compress": "zlib",
     "rabit_debug": "0",
     # Observability (rabit_tpu/obs, doc/observability.md): when
     # rabit_obs_dir (or the RABIT_OBS_DIR env var) is set, each rank dumps

@@ -131,6 +131,39 @@ class Engine(ABC):
         rank's slice, output is the concatenation over ranks (built on the
         reference's slice-addressed ring allgather, engine.h:56-79)."""
 
+    def allreduce_compressed(
+        self,
+        data: np.ndarray,
+        op: int,
+        codec,
+        prepare_fun: Callable[[np.ndarray], None] | None = None,
+        cache_key: str | None = None,
+    ) -> np.ndarray:
+        """Allreduce with a wire codec (rabit_tpu.compress): each rank's
+        contribution crosses the engine encoded; every rank decodes and
+        folds the gathered planes identically, so the result is bitwise
+        identical on all ranks and bitwise reproducible under replay.
+
+        Default implementation: the numpy host transport over this
+        engine's own primitives (encode -> one framed allgather, plus a
+        tiny size-agreement allreduce when the deflate stage makes wire
+        sizes data-dependent).  Backends with an in-graph path override
+        this (engine/xla.py runs encode/decode on-device so the flush
+        stays one fused device collective).
+
+        Unlike the exact path, ``prepare_fun`` runs eagerly — its output
+        feeds the encoder — which is always semantically safe (skipping it
+        on replay is an optimization, not a contract)."""
+        from rabit_tpu import compress as _compress
+
+        if prepare_fun is not None:
+            prepare_fun(data)
+        return _compress.host_allreduce(
+            self, np.ascontiguousarray(data), op, codec,
+            cache_key=cache_key,
+            deflate=_compress.policy().wire_deflate,
+        )
+
     # -- custom reduction --------------------------------------------------
 
     def allreduce_fn(

@@ -3,13 +3,15 @@
 The north-star capability (reference: guide/lazy_allreduce.cc and the lazy
 ``prepare_fun`` contract, rabit.h:182-206): instead of paying one collective
 per small buffer, pending reductions are queued and flushed as ONE
-allreduce per (dtype, op) group.  Works against any engine — the XLA engine
-turns the flush into a single fused device collective; the native engine
-into one TCP tree/ring pass.
+allreduce per (dtype, op, codec) group.  Works against any engine — the
+XLA engine turns the flush into a single fused device collective (a
+compressed group's planes are encoded on-device, so the fused buffer still
+crosses as one collective); the native engine into one TCP tree/ring pass.
 """
 
 from __future__ import annotations
 
+import inspect
 from typing import Any, Callable
 
 import numpy as np
@@ -33,42 +35,62 @@ class _Handle:
 
 class LazyAllreduce:
     """Queue buffers with ``add``; ``flush`` runs one fused allreduce per
-    (dtype, op) group and resolves every handle.
+    (dtype, op, codec) group and resolves every handle.
 
     Determinism contract (SURVEY hard part #3 — fusion must not break the
     robust engine's seqno/replay alignment): groups flush in first-queued
     order (dict insertion order), so as long as every rank queues the same
-    logical sequence of (dtype, op) buffers — the same requirement plain
-    collectives already have — every rank issues identical fused
+    logical sequence of (dtype, op, codec) buffers — the same requirement
+    plain collectives already have — every rank issues identical fused
     collectives in identical order, and each fused op gets a deterministic
-    seqno + replayable result like any other."""
+    seqno + replayable result like any other.
+
+    ``add(..., codec=...)`` tags a buffer with a rabit_tpu.compress codec:
+    same-codec buffers fuse into one compressed collective (a two-plane
+    codec's planes ride as planes of the single fused buffer), and
+    ``codec=None`` buffers still pick up the ``rabit_compress_allreduce``
+    policy at flush time exactly like a direct ``api.allreduce`` call.
+    """
 
     def __init__(self, allreduce_fn: Callable[..., np.ndarray] | None = None):
         if allreduce_fn is None:
             from rabit_tpu import api
 
-            allreduce_fn = lambda buf, op: api._get_engine().allreduce(buf, op)
+            allreduce_fn = lambda buf, op, codec=None: api.allreduce(
+                buf, op, codec=codec)
         self._allreduce = allreduce_fn
-        self._pending: list[tuple[np.ndarray, int, _Handle]] = []
+        try:
+            self._takes_codec = "codec" in inspect.signature(
+                allreduce_fn).parameters
+        except (TypeError, ValueError):
+            self._takes_codec = False
+        self._pending: list[tuple[np.ndarray, int, str | None, _Handle]] = []
 
-    def add(self, data: np.ndarray, op: int = SUM) -> _Handle:
+    def add(self, data: np.ndarray, op: int = SUM,
+            codec: str | None = None) -> _Handle:
         arr = np.ascontiguousarray(data)
         handle = _Handle()
-        self._pending.append((arr, op, handle))
+        self._pending.append((arr, op, codec, handle))
         return handle
 
     def __len__(self) -> int:
         return len(self._pending)
 
     def flush(self) -> None:
-        groups: dict[tuple[Any, int], list[tuple[np.ndarray, _Handle]]] = {}
-        for arr, op, handle in self._pending:
-            groups.setdefault((arr.dtype, op), []).append((arr, handle))
+        groups: dict[tuple[Any, int, str | None],
+                     list[tuple[np.ndarray, _Handle]]] = {}
+        for arr, op, codec, handle in self._pending:
+            groups.setdefault((arr.dtype, op, codec), []).append((arr, handle))
         self._pending.clear()
-        for (dtype, op), items in groups.items():
+        for (dtype, op, codec), items in groups.items():
             flats = [a.reshape(-1) for a, _ in items]
             fused = np.concatenate(flats) if len(flats) > 1 else flats[0].copy()
-            reduced = np.asarray(self._allreduce(fused, op))
+            if self._takes_codec:
+                reduced = np.asarray(self._allreduce(fused, op, codec=codec))
+            else:
+                # custom reducer without a codec seam: the codec still
+                # partitions the groups, but the fused buffer goes exact
+                reduced = np.asarray(self._allreduce(fused, op))
             offset = 0
             for arr, handle in items:
                 handle._result = (

@@ -221,21 +221,31 @@ def collective_seq() -> tuple[int, int]:
 
 
 @contextlib.contextmanager
-def collective(op: str, nbytes: int, cache_key: str | None = None):
+def collective(op: str, nbytes: int, cache_key: str | None = None,
+               codec: str | None = None):
     """The one timing/eventing path for every public collective: records
     ``op_begin``/``op_end`` events stamped with the cross-rank
     ``(version, seqno)`` identity, marks the thread in-flight for the hang
     watchdog, and times into the registry's per-op stats + latency
     histogram.  Yields a span whose ``nbytes`` may be updated inside the
-    window (object broadcast learns its length from the wire)."""
+    window (object broadcast learns its length from the wire).
+
+    ``codec`` (a rabit_tpu.compress codec name) joins the collective
+    identity in both events: ranks must agree on the codec of each logical
+    collective exactly as they agree on its (version, seqno), so a config
+    skew shows up as differing ``codec`` fields on the same identity in
+    the merged cross-rank trace — a detectable error, not silent
+    corruption (the wire transport additionally hard-fails on mismatched
+    frame ids; doc/compression.md, "Replay safety")."""
     tid = threading.get_ident()
     with _STATE.lock:
         version, seqno = _STATE.op_version, _STATE.op_seq
         _STATE.op_seq += 1
         _STATE.inflight[tid] = (op, cache_key, time.monotonic(), version,
                                 seqno)
+    extra = {} if codec is None else {"codec": codec}
     record_event("op_begin", op=op, nbytes=nbytes, cache_key=cache_key,
-                 version=version, seqno=seqno)
+                 version=version, seqno=seqno, **extra)
     t0 = time.perf_counter()
     span = _Span(op, nbytes, cache_key)
     try:
@@ -247,7 +257,7 @@ def collective(op: str, nbytes: int, cache_key: str | None = None):
         GLOBAL_REGISTRY.observe_op(op, span.nbytes, dt)
         record_event("op_end", op=op, nbytes=span.nbytes,
                      cache_key=cache_key, seconds=round(dt, 6),
-                     version=version, seqno=seqno)
+                     version=version, seqno=seqno, **extra)
 
 
 # -- failure-path dumps ------------------------------------------------------

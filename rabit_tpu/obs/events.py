@@ -58,6 +58,11 @@ KINDS: dict[str, str] = {
     "engine_finalize": "rabit_tpu.finalize() reached (pre-shutdown)",
     "engine_error": "native call failed: what, error (pre-exception)",
     "init_after_exception": "robust re-init after a caught exception",
+    # compression (rabit_tpu/compress, doc/compression.md)
+    "compress_policy": "codec policy resolved at init: allreduce codec, "
+                       "min_bytes, checkpoint codec, deflate stage",
+    "recovery_blob_compressed": "disk-resume blob served over the wire "
+                                "zlib-compressed: raw, wire, version",
     # checkpoint line (api.py / native bridge)
     "checkpoint_commit": "version bump committed: version, nbytes",
     "checkpoint_loaded": "bridge served a peer-recovered blob: version",

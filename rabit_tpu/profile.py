@@ -10,15 +10,16 @@ recorder of structured events.  This module keeps the historical surface:
 * ``CollectiveStats`` / ``OpStats`` / ``GLOBAL_STATS`` — now thin views
   over the process-wide :data:`rabit_tpu.obs.GLOBAL_REGISTRY`, so existing
   callers (``rt.collective_stats().report()``) keep working and gain
-  thread safety + histogram percentiles for free;
-* ``parse_stats_line`` / ``is_recovery_stats_line`` — the stdout-line
-  parsers, kept so historical logs remain readable.  **Deprecated** (each
-  call emits a ``DeprecationWarning``; removal horizon: two PRs after the
-  cross-rank tracing PR, see doc/observability.md): the tracker converts
-  the robust engine's ``recover_stats`` / ``failure_detected`` prints —
-  and the recovery workloads' ``recovered_at=`` / ``resumed from disk``
-  stamps — into structured events (``LocalCluster.events``,
-  ``telemetry.json``), which is what all in-repo tools consume now.
+  thread safety + histogram percentiles for free.
+
+The deprecated stdout-line parsers (``parse_stats_line`` /
+``is_recovery_stats_line``) reached their removal horizon and are gone:
+the tracker converts the robust engine's ``recover_stats`` /
+``failure_detected`` prints — and the recovery workloads'
+``recovered_at=`` / ``resumed from disk`` stamps — into structured events
+(``LocalCluster.events``, ``telemetry.json``), which every in-repo
+consumer reads; the undecorated line parser for that ingest lives in
+``rabit_tpu.obs.events``.
 
 Usage:
 
@@ -34,31 +35,8 @@ Usage:
 from __future__ import annotations
 
 import contextlib
-import warnings
 
-from rabit_tpu.obs import events as _events
 from rabit_tpu.obs.metrics import GLOBAL_REGISTRY, MetricsRegistry, OpStats
-
-
-def _deprecated_parser(name: str) -> None:
-    warnings.warn(
-        f"rabit_tpu.profile.{name} is deprecated (removal: two PRs after "
-        "the cross-rank tracing PR): consume structured events instead — "
-        "LocalCluster.events / telemetry.json, see doc/observability.md",
-        DeprecationWarning, stacklevel=3,
-    )
-
-
-def parse_stats_line(line: str) -> dict[str, str]:
-    """Deprecated stdout-line parser (kept for historical logs)."""
-    _deprecated_parser("parse_stats_line")
-    return _events.parse_stats_line(line)
-
-
-def is_recovery_stats_line(line: str) -> bool:
-    """Deprecated stdout-line classifier (kept for historical logs)."""
-    _deprecated_parser("is_recovery_stats_line")
-    return _events.is_recovery_stats_line(line)
 
 
 class CollectiveStats:

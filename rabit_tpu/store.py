@@ -30,14 +30,25 @@ _KEEP = 2  # two-phase commit skews live ranks by at most one version
 # that fails the check (torn by a crash the rename protocol could not
 # cover, or bit-rotted) reads as ABSENT, so resume degrades to an older
 # version or the holder-broadcast path instead of crashing on garbage.
+#
+# Two frame generations: RTC1 (uncompressed payload) and RTC2, which adds
+# a codec byte (rabit_tpu.compress ids) so spilled blobs land compressed
+# (rabit_checkpoint_compress, default zlib).  The crc covers the ENCODED
+# payload — integrity is checked before any decode touches the bytes —
+# and RTC1 frames from older jobs stay readable forever.
 _MAGIC = b"RTC1"
 _HDR = struct.Struct("<4sII")
+_MAGIC2 = b"RTC2"
+_HDR2 = struct.Struct("<4sBxxxII")  # magic, codec id, pad, crc, enc len
 
 
 class CheckpointStore:
-    def __init__(self, directory: str, rank: int):
+    def __init__(self, directory: str, rank: int, codec: str = "zlib"):
+        from rabit_tpu.compress import get_codec
+
         self.dir = Path(directory)
         self.rank = rank
+        self._codec = None if codec in ("", "identity") else get_codec(codec)
         self.dir.mkdir(parents=True, exist_ok=True)
         # One directory scan at startup seeds the version list (and sweeps
         # tmp leftovers of crashed saves); after that, save() maintains it
@@ -79,10 +90,20 @@ class CheckpointStore:
                 self._cache.pop(p, None)
 
     def _write(self, path: Path, blob: bytes) -> None:
+        if self._codec is None:
+            header, payload = _HDR.pack(_MAGIC, zlib.crc32(blob),
+                                        len(blob)), blob
+        else:
+            from rabit_tpu.compress import observe
+
+            payload = self._codec.encode_bytes(blob)
+            observe(self._codec.name, raw=len(blob), wire=len(payload))
+            header = _HDR2.pack(_MAGIC2, self._codec.codec_id,
+                                zlib.crc32(payload), len(payload))
         tmp = path.with_suffix(".tmp")
         with open(tmp, "wb") as f:
-            f.write(_HDR.pack(_MAGIC, zlib.crc32(blob), len(blob)))
-            f.write(blob)
+            f.write(header)
+            f.write(payload)
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, path)  # atomic: readers see old or new, never torn
@@ -112,24 +133,39 @@ class CheckpointStore:
         return 0
 
     def _read_checked(self, path: Path) -> bytes | None:
-        """The payload, or None when missing/torn/corrupt.  Verified reads
-        are memoized so the resume path (latest_valid -> has -> load) does
-        not re-read multi-MB blobs; writes/prunes keep the memo fresh."""
+        """The DECODED payload, or None when missing/torn/corrupt.
+        Verified reads are memoized so the resume path (latest_valid ->
+        has -> load) does not re-read multi-MB blobs; writes/prunes keep
+        the memo fresh.  Both frame generations read back: RTC2 carries a
+        codec byte (decode after the crc passes), RTC1 is the legacy
+        uncompressed layout — a new job resumes an old job's spill
+        unchanged."""
         if path in self._cache:
             return self._cache[path]
         try:
             raw = path.read_bytes()
         except FileNotFoundError:
             return None
-        bad = len(raw) < _HDR.size
-        if not bad:
+        blob: bytes | None = None
+        if len(raw) >= _HDR2.size and raw[:4] == _MAGIC2:
+            _magic, codec_id, crc, n = _HDR2.unpack_from(raw)
+            enc = raw[_HDR2.size:]
+            if len(enc) == n and zlib.crc32(enc) == crc:
+                from rabit_tpu.compress import get_codec_by_id
+
+                try:
+                    blob = get_codec_by_id(codec_id).decode_bytes(enc)
+                except (ValueError, zlib.error):
+                    blob = None  # unknown codec / stream the crc cannot vouch for
+        elif len(raw) >= _HDR.size and raw[:4] == _MAGIC:
             magic, crc, n = _HDR.unpack_from(raw)
-            blob = raw[_HDR.size:]
-            bad = magic != _MAGIC or len(blob) != n or zlib.crc32(blob) != crc
-        if bad:
+            payload = raw[_HDR.size:]
+            if len(payload) == n and zlib.crc32(payload) == crc:
+                blob = payload
+        if blob is None:
             print(f"[rabit_tpu] checkpoint store: ignoring unreadable blob "
-                  f"{path} (missing/invalid RTC1 header or crc mismatch)",
-                  flush=True)
+                  f"{path} (missing/invalid RTC1/RTC2 header or crc "
+                  f"mismatch)", flush=True)
             return None
         self._cache[path] = blob
         return blob

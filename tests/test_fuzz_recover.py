@@ -129,10 +129,7 @@ def draw_schedule(seed: int) -> tuple[int, list[str]]:
     return world, args
 
 
-@pytest.mark.parametrize("seed", range(SEED_BASE, SEED_BASE + N_SEEDS),
-                         ids=lambda s: f"seed{s}")
-def test_fuzzed_kill_schedule(seed: int):
-    world, args = draw_schedule(seed)
+def _run_schedule(seed: int, world: int, args: list[str]) -> None:
     cmd = [sys.executable, WORKER, "rabit_engine=mock", *args]
     cluster = LocalCluster(world, max_restarts=12, quiet=True)
     try:
@@ -156,3 +153,33 @@ def test_fuzzed_kill_schedule(seed: int):
         f"seed {seed} (RABIT_FUZZ_WORLD_MAX={WORLD_MAX}): "
         f"world={world} args={args!r} "
         f"returncodes={cluster.returncodes}")
+
+
+@pytest.mark.parametrize("seed", range(SEED_BASE, SEED_BASE + N_SEEDS),
+                         ids=lambda s: f"seed{s}")
+def test_fuzzed_kill_schedule(seed: int):
+    world, args = draw_schedule(seed)
+    _run_schedule(seed, world, args)
+
+
+# Compressed-collective campaign (ISSUE 5): the same randomized kill
+# schedules with rabit_compress_allreduce=i8x2 forced onto every f32
+# collective (min_bytes=1).  The worker self-checks the compressed MAX op
+# against the codec's closed-form reference fold with np.array_equal, so a
+# kill mid-flush must still deliver the BITWISE-identical result after
+# replay — the compressed path's two-op wire sequence (size agreement +
+# framed allgather) has to hold the robust engine's positional
+# seqno/replay contract exactly like a plain collective.  Campaign knob:
+# RABIT_FUZZ_COMPRESS_SEEDS widens past the CI default of 10.
+N_COMPRESS_SEEDS = int(os.environ.get("RABIT_FUZZ_COMPRESS_SEEDS", "10"))
+COMPRESS_SEED_BASE = 5000  # disjoint from the exact campaign's draw range
+
+
+@pytest.mark.parametrize(
+    "seed", range(COMPRESS_SEED_BASE, COMPRESS_SEED_BASE + N_COMPRESS_SEEDS),
+    ids=lambda s: f"seed{s}")
+def test_fuzzed_kill_schedule_compressed(seed: int):
+    world, args = draw_schedule(seed)
+    args += ["rabit_compress_allreduce=i8x2", "rabit_compress_min_bytes=1",
+             "codec=i8x2"]
+    _run_schedule(seed, world, args)

@@ -33,27 +33,23 @@ def test_timed_context():
     assert s.ops["allgather"].max_seconds >= 0
 
 
-def test_parse_stats_line():
-    # The profile-level parsers are a deprecated facade now: every call
-    # must warn (removal horizon in doc/observability.md) but keep parsing
-    # so historical logs stay readable.
-    import pytest
+def test_deprecated_parsers_removed():
+    # The deprecated profile-level stdout parsers reached their removal
+    # horizon (two PRs after the cross-rank tracing PR): the facade is
+    # gone; the structured-events ingest keeps the undecorated parser.
+    import rabit_tpu.profile as profile
 
-    from rabit_tpu.profile import is_recovery_stats_line, parse_stats_line
+    assert not hasattr(profile, "parse_stats_line")
+    assert not hasattr(profile, "is_recovery_stats_line")
+
+    from rabit_tpu.obs.events import is_recovery_stats_line, parse_stats_line
 
     line = ("[3] recover_stats version=2 summary_rounds=4 table_rounds=2 "
             "serve_bytes=1048576 summary_depth=8 table_hops=14")
-    with pytest.deprecated_call():
-        kv = parse_stats_line(line)
+    kv = parse_stats_line(line)
     assert kv["version"] == "2"
     assert int(kv["summary_depth"]) == 8
     assert int(kv["table_hops"]) == 14
     # values containing '=' split only on the first (key=value contract)
-    with pytest.deprecated_call():
-        assert parse_stats_line("k=a=b x")["k"] == "a=b"
-    with pytest.deprecated_call():
-        assert is_recovery_stats_line(line)
-    # the structured-events layer keeps the undecorated parser
-    from rabit_tpu.obs.events import parse_stats_line as raw_parse
-
-    assert raw_parse(line)["version"] == "2"
+    assert parse_stats_line("k=a=b x")["k"] == "a=b"
+    assert is_recovery_stats_line(line)

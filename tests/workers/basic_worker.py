@@ -93,6 +93,25 @@ def main():
     check(np.allclose(h2.get(), world * (world - 1)), "fused sum b")
     check(h3.get()[0] == (1 << world) - 1, "fused bitor")
 
+    # compressed allreduce (rabit_tpu.compress): every engine must deliver
+    # a rank-consistent result within the codec's documented bound.  Host
+    # engines are BITWISE-equal to the closed-form reference fold; the XLA
+    # engine's on-device fold decodes the same planes but may re-associate
+    # the f32 sum, hence the tolerance here (the bitwise contract for host
+    # engines is enforced by recover_worker's codec= mode).
+    from rabit_tpu.compress import reference_allreduce
+
+    data = (np.arange(256, dtype=np.float32) / 7.0) + rank
+    out = rt.allreduce(data, rt.SUM, codec="i8x2")
+    ref = reference_allreduce(
+        [(np.arange(256, dtype=np.float32) / 7.0) + r for r in range(world)],
+        rt.SUM, "i8x2")
+    check(out.dtype == np.float32 and out.shape == data.shape,
+          "compressed allreduce shape/dtype")
+    check(np.allclose(out, ref, rtol=1e-5, atol=1e-4),
+          f"compressed allreduce i8x2 (max diff "
+          f"{np.max(np.abs(out - ref))})")
+
     # checkpoint / load_checkpoint roundtrip (every backend must version and
     # return committed state, even those without cross-process recovery)
     v0, m0 = rt.load_checkpoint()

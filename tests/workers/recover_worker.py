@@ -35,6 +35,15 @@ Worker args (k=v on the command line, all also forwarded to the engine):
     stop_at=K      every worker exits cleanly right after checkpoint K —
                    simulates a whole-job preemption for the durable-spill
                    resume tests (pair with rabit_checkpoint_dir=...)
+    codec=NAME     self-check the f32 MAX allreduce against the codec's
+                   closed-form reference fold (rabit_tpu.compress
+                   .reference_allreduce) instead of the exact expectation —
+                   pair with rabit_compress_allreduce=NAME (+ a small
+                   rabit_compress_min_bytes) so the engine actually
+                   compresses.  The check is EXACT (np.array_equal): a
+                   compressed collective's delivery, including a
+                   post-recovery replay, must be bitwise identical to the
+                   deterministic reference fold.
 """
 
 import os
@@ -81,6 +90,7 @@ def main() -> int:
     use_local = getarg("local", "0") == "1"
     use_lazy = getarg("lazy", "0") == "1"
     preload_op = getarg("preload_op", "0") == "1"
+    codec = getarg("codec", "")
 
     rt.init()
     rank = rt.get_rank()
@@ -147,7 +157,18 @@ def main() -> int:
         # MAX: data[i] = rank + i + it  ->  world-1 + i + it
         a = (np.arange(ndata) + rank + it).astype(np.float32)
         out = rt.allreduce(a, rt.MAX)
-        expect = (np.arange(ndata) + world - 1 + it).astype(np.float32)
+        if codec:
+            # Compressed path (policy from the engine args): the expected
+            # value is the codec's reference fold over every rank's known
+            # contribution — bitwise, including after recovery replay.
+            from rabit_tpu.compress import reference_allreduce
+
+            expect = reference_allreduce(
+                [(np.arange(ndata) + r + it).astype(np.float32)
+                 for r in range(world)],
+                rt.MAX, codec)
+        else:
+            expect = (np.arange(ndata) + world - 1 + it).astype(np.float32)
         check(np.array_equal(out, expect), f"iter {it} max {out[:4]}")
 
         # broadcast an object from a rotating root

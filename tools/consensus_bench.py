@@ -67,7 +67,7 @@ def run_mode(world: int, iters: int, summary_on: bool) -> tuple[float, dict]:
         # Protocol-structure counters from rank 0's shutdown-time
         # recover_stats_final, delivered as a structured tracker event
         # (cluster.events — the tracker converts the print at ingest; the
-        # old parse_stats_line scraping is deprecated): per-op
+        # old parse_stats_line scraping was removed in PR 5): per-op
         # critical-path depth, the scheduling-independent O(log W) vs O(W)
         # exhibit (wall clocks at oversubscribed worlds measure the
         # scheduler, these measure the protocol).

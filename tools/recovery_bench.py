@@ -85,8 +85,8 @@ def run_once(world: int, extra: list[str], timeout: float | None = None,
     # latency role the reference's unused OOB urgent-byte path targeted.
     # Structured events (cluster.events): the tracker converts the robust
     # engine's failure_detected / recover_stats prints into typed events —
-    # no stdout scraping (the old parse_stats_line path is deprecated,
-    # see rabit_tpu/profile.py).
+    # no stdout scraping (the old profile.parse_stats_line facade was
+    # removed in PR 5; the ingest parser lives in rabit_tpu.obs.events).
     detect = None
     detects = [ev["at"] for ev in cluster.events
                if ev["kind"] == "failure_detected" and "at" in ev]
