@@ -73,6 +73,14 @@ CODEC_ROWS = int(os.environ.get("RABIT_BENCH_CODEC_ROWS", "150000"))
 CODEC_ROUNDS = 2
 CODEC_CHILD_TIMEOUT = 210.0
 CODECS_RACED = ("identity", "bf16x2", "i8x2", "i8")
+# Elastic membership bench (ISSUE 6): one CPU child runs the seeded
+# promote/shrink/grow scenarios (tools/recovery_bench.py --elastic) and
+# reports the spare-promotion-latency vs shrink-wave-latency curve from
+# structured tracker events.  Cheap (~15s, no jax import) and deducted
+# from the TPU budget like the codec ablation; RABIT_BENCH_ELASTIC=0
+# skips it.
+ELASTIC_BENCH = os.environ.get("RABIT_BENCH_ELASTIC", "1") != "0"
+ELASTIC_CHILD_TIMEOUT = 120.0
 
 
 def log(msg):
@@ -335,6 +343,38 @@ def run_codec_ablation(timeout=CODEC_CHILD_TIMEOUT):
     return lines
 
 
+def run_elastic_bench(timeout=ELASTIC_CHILD_TIMEOUT):
+    """Run the elastic-membership scenarios (tools/recovery_bench.py
+    --elastic) in a child; returns the per-world JSON lines (possibly
+    empty on timeout/failure — the elastic curve must never cost the main
+    metric its line)."""
+    cmd = [sys.executable,
+           os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "tools", "recovery_bench.py"),
+           "--elastic", "2", "4"]
+    try:
+        r = subprocess.run(cmd, timeout=timeout, capture_output=True,
+                           text=True)
+        stdout, rc = r.stdout, r.returncode
+    except subprocess.TimeoutExpired as te:
+        stdout = (te.stdout.decode(errors="replace")
+                  if isinstance(te.stdout, bytes) else (te.stdout or ""))
+        rc = None
+        log(f"elastic bench child timed out after {timeout:.0f}s; "
+            "keeping the lines it already measured")
+    if rc not in (0, None):
+        log(f"elastic bench child rc={rc}")
+    lines = []
+    for line in stdout.strip().splitlines():
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(rec, dict) and rec.get("mode") == "elastic":
+            lines.append(rec)
+    return lines
+
+
 def probe_device(timeout=45.0) -> bool:
     """Fast TPU liveness check in a throwaway child: a wedged axon tunnel
     hangs at backend init (holding jax's lock forever), and burning the
@@ -483,6 +523,14 @@ def main():
                          min(TPU_WALL_BUDGET, 300.0))
         log(f"codec ablation: {len(codec_lines)} line(s); "
             f"TPU budget now {tpu_budget:.0f}s")
+    elastic_lines = []
+    if ELASTIC_BENCH:
+        t_el = time.time()
+        elastic_lines = run_elastic_bench()
+        tpu_budget = max(tpu_budget - (time.time() - t_el),
+                         min(tpu_budget, 300.0))
+        log(f"elastic bench: {len(elastic_lines)} line(s); "
+            f"TPU budget now {tpu_budget:.0f}s")
     res = try_tpu_within_budget(tpu_budget)
     n_rows = N_ROWS
     if not isinstance(res, dict):
@@ -508,6 +556,8 @@ def main():
             rec["last_tpu_capture"] = cap
         if codec_lines:
             rec["codec_ablation"] = codec_lines
+        if elastic_lines:
+            rec["elastic"] = elastic_lines
         print(json.dumps(rec), flush=True)
         return
     device_time = res["device_time"]
@@ -549,6 +599,8 @@ def main():
             rec["last_tpu_capture"] = cap
     if codec_lines:
         rec["codec_ablation"] = codec_lines
+    if elastic_lines:
+        rec["elastic"] = elastic_lines
     print(json.dumps(rec), flush=True)
 
 

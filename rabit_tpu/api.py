@@ -36,6 +36,13 @@ _engine: Engine | None = None
 _ckpt_store = None
 _ckpt_base = 0
 
+# Elastic-world state (rabit_tpu/elastic, doc/elasticity.md): the world
+# epoch this process last adopted, and the shard-rebalance callbacks run
+# when it changes.  The epoch is stamped into durable checkpoint frames
+# (RTC3) so replay stays deterministic across a resize.
+_world_epoch: dict = {"epoch": 0, "world_size": 1}
+_rebalance_cbs: list[Callable[[dict, dict], None]] = []
+
 _WRAP_TAG = "__rabit_tpu_ckpt1__"
 
 
@@ -128,8 +135,9 @@ def init(args: list[str] | None = None, **overrides: Any) -> None:
         rank=_engine.get_rank(),
         world=_engine.get_world_size(),
     )
-    global _ckpt_store, _ckpt_base
+    global _ckpt_store, _ckpt_base, _world_epoch
     _ckpt_base = 0
+    _world_epoch = {"epoch": 0, "world_size": _engine.get_world_size()}
     ckpt_dir = cfg.get("rabit_checkpoint_dir", "") or ""
     if ckpt_dir and ckpt_dir != "NULL":
         from rabit_tpu.store import CheckpointStore
@@ -144,7 +152,7 @@ def finalize() -> None:
     """Shut down the engine (reference: RabitFinalize).  Ships the final
     metrics snapshot to the tracker first — the tracker keeps serving until
     every rank's shutdown handshake, so the snapshot always lands."""
-    global _engine, _ckpt_store, _ckpt_base
+    global _engine, _ckpt_store, _ckpt_base, _world_epoch
     if _engine is not None:
         obs.ship_final_snapshot()
         obs.record_event("engine_finalize", engine=type(_engine).__name__)
@@ -156,6 +164,66 @@ def finalize() -> None:
     compress.reset()
     _ckpt_store = None
     _ckpt_base = 0
+    _world_epoch = {"epoch": 0, "world_size": 1}
+
+
+def world_epoch() -> dict:
+    """The world epoch this process last adopted: ``{"epoch", "world_size"}``
+    (doc/elasticity.md).  Epoch 0 / the engine's world until an elastic
+    resize is observed."""
+    return dict(_world_epoch)
+
+
+def register_rebalance(callback: Callable[[dict, dict], None]) -> None:
+    """Register a shard-rebalance callback ``callback(old, new)`` invoked
+    whenever this process adopts a new world epoch (``old``/``new`` are
+    ``world_epoch()``-shaped dicts).  The GBDT histogram deployment re-cuts
+    its data shard here (``models.gbdt.elastic_shard`` /
+    ``elastic.rebalance.shard_slice``) so the fold keeps covering the whole
+    dataset around the hole.  Callbacks must be idempotent; exceptions
+    propagate to the notifier."""
+    if callback not in _rebalance_cbs:
+        _rebalance_cbs.append(callback)
+
+
+def unregister_rebalance(callback: Callable[[dict, dict], None]) -> None:
+    try:
+        _rebalance_cbs.remove(callback)
+    except ValueError:
+        pass
+
+
+def notify_world_change(epoch: int, world_size: int) -> None:
+    """Adopt a new world epoch: record it (checkpoint frames stamp it from
+    here), emit the ``epoch_changed``/``shard_rebalanced`` evidence, and
+    run the registered rebalance callbacks."""
+    global _world_epoch
+    old = dict(_world_epoch)
+    if epoch == old["epoch"] and world_size == old["world_size"]:
+        return
+    _world_epoch = {"epoch": int(epoch), "world_size": int(world_size)}
+    obs.record_event("epoch_changed", epoch=int(epoch),
+                     world=int(world_size), prev_world=old["world_size"])
+    for cb in list(_rebalance_cbs):
+        cb(old, dict(_world_epoch))
+    if _rebalance_cbs:
+        obs.record_event("shard_rebalanced", epoch=int(epoch),
+                         callbacks=len(_rebalance_cbs))
+
+
+def rebootstrap() -> dict:
+    """Re-enter the tracker after a world-epoch change: the native engine
+    finalizes and re-bootstraps (fresh assignment, possibly a different
+    world), the XLA engine rebuilds its process mesh, and the adopted
+    epoch is bumped so rebalance callbacks and checkpoint stamps follow.
+    Returns the new ``world_epoch()``."""
+    engine = _get_engine()
+    if hasattr(engine, "rebootstrap"):
+        engine.rebootstrap()
+    elif hasattr(engine, "rebuild_mesh"):
+        engine.rebuild_mesh()
+    notify_world_change(_world_epoch["epoch"] + 1, engine.get_world_size())
+    return world_epoch()
 
 
 def get_rank() -> int:
@@ -422,8 +490,12 @@ def checkpoint(global_model: Any, local_model: Any = None) -> None:
     engine.checkpoint(wrapped, lblob)
     _note_commit(engine, len(wrapped))
     # Persist AFTER the commit barrier: live ranks' disk versions can then
-    # skew by at most one, which the store's keep-2 retention covers.
-    _ckpt_store.save(_ckpt_base + engine.version_number(), wrapped, lblob)
+    # skew by at most one, which the store's keep-2 retention covers.  The
+    # adopted world epoch rides in the frame (RTC3) so a resume can tell
+    # which membership generation produced each version — replay across a
+    # resize stays deterministic (doc/elasticity.md).
+    _ckpt_store.save(_ckpt_base + engine.version_number(), wrapped, lblob,
+                     epoch=_world_epoch["epoch"])
 
 
 def lazy_checkpoint(global_model: Any) -> None:

@@ -28,6 +28,13 @@ tools/chaos_bench.py): it drives full bootstrap + recovery waves of
 thread-workers through the proxy against a real in-process tracker, heals
 the network, and requires the job to converge — completion or fail-fast,
 never a hang.
+
+:func:`run_elastic_schedule` is its elastic sibling (tests/test_elastic.py,
+tools/recovery_bench.py --elastic): seeded shrink/grow wave scenarios —
+kills WITHOUT restart, delayed spare arrivals, spares dying parked or
+mid-promotion — driven through real :class:`~rabit_tpu.elastic.client.
+ElasticWorker` threads against an elastic tracker, with heal-then-must-
+converge and bitwise-correctness asserts at every intermediate world size.
 """
 
 from __future__ import annotations
@@ -37,6 +44,8 @@ import socket
 import threading
 import time
 from dataclasses import dataclass, field
+
+import numpy as np
 
 from rabit_tpu.tracker import protocol as P
 from rabit_tpu.tracker.tracker import Tracker
@@ -391,4 +400,200 @@ def run_schedule(seed: int, world: int | None = None,
         epoch=epoch, rank_of=dict(rank_of),
         elapsed=time.monotonic() - t0, stats=proxy.stats,
         outcome="completed" if completed else "failed_fast",
+    )
+
+
+# -- elastic fuzz schedule runner ---------------------------------------------
+
+@dataclass
+class ElasticScheduleResult:
+    seed: int
+    world: int
+    n_spares: int
+    niter: int
+    n_completed: int
+    n_died: int
+    worlds_seen: list[int]
+    epochs: list[dict]
+    elapsed: float
+    outcome: str  # "completed" | "failed"
+
+
+def run_elastic_schedule(seed: int, world: int | None = None,
+                         deadline_sec: float = 30.0,
+                         quiet: bool = True) -> ElasticScheduleResult:
+    """One fuzzed shrink/grow scenario (deterministic per seed).
+
+    A seeded mix of elastic failure shapes against a real elastic tracker:
+
+    * **kill-without-restart** — workers die silently at a sampled version
+      and nothing relaunches them (the preempted-fleet shape; the
+      launcher's restart loop is deliberately absent);
+    * **delayed spare arrival** — hot spares park a sampled delay after
+      launch, so promotions race shrinks and grow-backs race completion;
+    * **spare dying parked / mid-promotion** — a spare's warm socket goes
+      dead in the pool, or the instant its promotion Assignment lands.
+
+    Every worker runs the deterministic histogram workload over one shared
+    dataset, re-cut per epoch by the dense elastic partition — so at EVERY
+    intermediate world size the rank-order int64 fold must reproduce the
+    exact closed-form totals.  Task "0" is never killed, so at least one
+    worker must complete; any outcome is acceptable except a hang (every
+    socket operation is bounded and the schedule deadline converts "stuck"
+    into a hard failure) or a wrong bit.
+
+    Asserts (raising on violation, like :func:`run_schedule`):
+    completion of all never-killed workers, bitwise-correct final states,
+    dense distinct ranks in every committed wave, strictly increasing
+    epochs.
+    """
+    from rabit_tpu.elastic.client import ElasticWorker
+    from rabit_tpu.elastic.rebalance import shard_slice
+
+    rng = random.Random(seed)
+    world = world if world is not None else rng.choice([2, 3, 4])
+    n_spares = rng.choice([0, 1, 2])
+    niter = rng.choice([3, 4, 5])
+    iter_sleep = rng.choice([0.05, 0.1])
+    n_rows, n_bins = 8 * world, 8
+    data = np.array([rng.randrange(n_bins) for _ in range(n_rows)])
+
+    def contribution(version: int, w: int, r: int) -> np.ndarray:
+        time.sleep(iter_sleep)
+        rows = data[shard_slice(n_rows, w, r)]
+        return np.bincount(rows, minlength=n_bins).astype(np.int64) * version
+
+    expected = sum(np.bincount(data, minlength=n_bins).astype(np.int64) * v
+                   for v in range(1, niter + 1))
+
+    n_kills = rng.randint(0, min(world - 1, 2))
+    victims = rng.sample([str(i) for i in range(1, world)], n_kills)
+    kill_at = {t: rng.randint(2, niter) for t in victims}
+    spare_specs = []
+    for i in range(n_spares):
+        roll = rng.random()
+        fail = (("die_parked",) if roll < 0.15
+                else ("die_promoted",) if roll < 0.3 else None)
+        spare_specs.append((f"s{i}", rng.uniform(0.0, 0.8), fail))
+
+    # shrink_after must outlast the workers' link timeout: a survivor that
+    # detects a death slowly (accept-side wait for a dead dialer) re-enters
+    # only after link_timeout, and a shorter shrink deadline would close
+    # the wave without it — splitting the job (doc/elasticity.md, "Choosing
+    # the knobs").
+    tracker = Tracker(world, quiet=quiet, conn_timeout_sec=1.0,
+                      shrink_after_sec=1.5, promote_after_sec=0.1).start()
+    addr = (tracker.host, tracker.port)
+    t0 = time.monotonic()
+    results: dict[str, object] = {}
+    lock = threading.Lock()
+
+    def run_worker(w: "ElasticWorker") -> None:
+        res = w.run()
+        with lock:
+            results[w.task_id] = res
+
+    threads = []
+    for i in range(world):
+        tid = str(i)
+        fail = ("die", kill_at[tid]) if tid in kill_at else None
+        w = ElasticWorker(addr, tid, contribution, niter,
+                          heartbeat_sec=0.15, rpc_timeout=2.0,
+                          wave_timeout=10.0, link_timeout=1.0,
+                          deadline_sec=deadline_sec, fail=fail)
+        threads.append(threading.Thread(target=run_worker, args=(w,),
+                                        daemon=True))
+
+    spare_workers: list["ElasticWorker"] = []
+
+    def run_spare(tid: str, delay: float, fail: tuple | None) -> None:
+        time.sleep(delay)
+        if time.monotonic() - t0 > deadline_sec:
+            return
+        w = ElasticWorker(addr, tid, contribution, niter, spare=True,
+                          heartbeat_sec=0.15, rpc_timeout=2.0,
+                          wave_timeout=10.0, link_timeout=1.0,
+                          deadline_sec=max(deadline_sec
+                                           - (time.monotonic() - t0), 1.0),
+                          fail=fail)
+        with lock:
+            spare_workers.append(w)
+        run_worker(w)
+
+    spare_threads = [threading.Thread(target=run_spare,
+                                      args=(tid, delay, fail), daemon=True)
+                     for tid, delay, fail in spare_specs]
+    try:
+        for th in threads + spare_threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=deadline_sec + 10.0 - (time.monotonic() - t0))
+            if th.is_alive():
+                raise TimeoutError(
+                    f"elastic schedule seed={seed}: worker thread hung past "
+                    f"the schedule deadline ({deadline_sec}s)")
+    finally:
+        # Primaries are done (or the schedule failed): release the pool —
+        # stop() closes the warm sockets, so spares that were never
+        # promoted exit their park loop instead of waiting out their
+        # deadline.  A promoted spare finished with the group (collectives
+        # are lockstep), so the short join below is enough.
+        tracker.stop()
+        # A promoted spare mid-recovery would otherwise spin its bounded
+        # re-check-in loop against the stopped tracker until its own
+        # deadline — stop() flips it to a fast, clean exit.
+        with lock:
+            for w in spare_workers:
+                w.stop()
+        for th in spare_threads:
+            th.join(timeout=10.0)
+    for th in spare_threads:
+        if th.is_alive():
+            raise TimeoutError(
+                f"elastic schedule seed={seed}: spare thread hung after "
+                f"tracker stop")
+
+    completed = [r for r in results.values() if r.completed]
+    died = [r for r in results.values() if r.died]
+    # -- convergence: every never-killed primary completes with the exact
+    # closed-form totals, no matter which world sizes it passed through.
+    for i in range(world):
+        tid = str(i)
+        if tid in kill_at:
+            continue
+        res = results.get(tid)
+        if res is None or not res.completed:
+            raise AssertionError(
+                f"seed={seed}: surviving worker {tid} did not complete: "
+                f"{getattr(res, 'error', 'no result')!r}")
+    for res in completed:
+        if res.final_version != niter:
+            raise AssertionError(
+                f"seed={seed}: task {res.task_id} completed at version "
+                f"{res.final_version}, wanted {niter}")
+        if not np.array_equal(res.state, expected):
+            raise AssertionError(
+                f"seed={seed}: task {res.task_id} state {res.state!r} != "
+                f"expected {expected!r} (worlds seen: {res.worlds})")
+    # -- membership sanity on the tracker's committed timeline.
+    waves = [e for e in tracker.events if e["kind"] == "wave"]
+    epochs = [e["epoch"] for e in waves]
+    if epochs != sorted(set(epochs)):
+        raise AssertionError(f"seed={seed}: epochs not strictly "
+                             f"increasing: {epochs}")
+    for e in waves:
+        ranks = sorted(e["assignments"].values())
+        if ranks != list(range(e["world"])):
+            raise AssertionError(
+                f"seed={seed}: wave epoch {e['epoch']} ranks {ranks} not "
+                f"dense for world {e['world']}")
+    worlds_seen = sorted({e["world"] for e in waves})
+    return ElasticScheduleResult(
+        seed=seed, world=world, n_spares=n_spares, niter=niter,
+        n_completed=len(completed), n_died=len(died),
+        worlds_seen=worlds_seen,
+        epochs=[{"epoch": we.epoch, "world": we.world_size}
+                for we in tracker.elastic.history],
+        elapsed=time.monotonic() - t0,
+        outcome="completed",
     )

@@ -113,6 +113,20 @@ DEFAULTS: dict[str, str] = {
     # worker-side belt to the tracker lease's suspenders.
     "rabit_heartbeat_sec": "0",
     "rabit_hang_abort_sec": "0",
+    # Elastic worlds (rabit_tpu/elastic, doc/elasticity.md).
+    # rabit_spare=1 marks a worker as a HOT SPARE: it checks in with
+    # CMD_SPARE, receives the cached compressed bootstrap blob, and parks
+    # on a warm socket until the tracker promotes it into a dead rank's
+    # slot.  rabit_shrink_after_sec > 0 lets a recovery wave close SHRUNK
+    # when no spare arrives within the deadline (0 keeps the legacy
+    # block-until-full contract); rabit_min_world floors the shrink.
+    # rabit_spare_promote_sec is the grace before a short wave steals a
+    # parked spare — a slow-but-live worker's own check-in wins the slot
+    # inside the grace.
+    "rabit_spare": "0",
+    "rabit_shrink_after_sec": "0",
+    "rabit_min_world": "1",
+    "rabit_spare_promote_sec": "0.25",
     # Cross-rank tracing (rabit_tpu/obs/trace.py, tools/trace_tool.py).
     # rabit_trace_exit=1: dump the flight ring as flight-*-exit.jsonl at
     # finalize, so CLEAN runs leave the per-rank evidence the job-wide

@@ -1,0 +1,59 @@
+"""rabit_tpu.elastic — elastic worlds: membership epochs, hot spares,
+shrink/grow recovery waves (ISSUE 6 tentpole; doc/elasticity.md).
+
+Three pieces:
+
+* **membership** — the pure world-epoch state machine the tracker
+  delegates to: a monotonically increasing ``(epoch, world_size,
+  rank_map)`` line, wave decisions (promote a parked spare / shrink to
+  the survivors / grow back toward the launch size), and rank-map
+  deltas;
+* **rebalance** — the dense shard re-partition every rank recomputes
+  locally from ``(n_rows, world, rank)`` when the world resizes, plus
+  the rank-order fold that keeps collectives bitwise reproducible
+  across resizes;
+* **client** — the elastic worker harness: spare parking on a warm
+  socket, epoch-stamped ring links, deterministic allreduce, post-wave
+  state consensus, version-boundary epoch polling.
+"""
+
+from rabit_tpu.elastic.membership import (  # noqa: F401 (re-exports)
+    MembershipManager,
+    WaveDecision,
+    WorldEpoch,
+    rank_map_delta,
+)
+from rabit_tpu.elastic.rebalance import (  # noqa: F401 (re-exports)
+    rebalance_plan,
+    refold,
+    shard_bounds,
+    shard_slice,
+)
+#: client re-exports resolve lazily (PEP 562): the client rides the
+#: tracker protocol and obs shipping, both of which import THIS package
+#: through the tracker's membership delegation — an eager import here
+#: would be a cycle.
+_CLIENT_EXPORTS = ("ElasticWorker", "ElasticResult", "EpochBroken")
+
+
+def __getattr__(name: str):
+    if name in _CLIENT_EXPORTS:
+        from rabit_tpu.elastic import client
+
+        return getattr(client, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def settings(cfg) -> dict:
+    """Resolve the elastic config keys (doc/parameters.md, "Elastic
+    worlds") into the tracker/launcher-facing knobs: whether this worker
+    is a hot spare, the shrink deadline, the world floor, and the
+    spare-promotion grace."""
+    return {
+        "spare": cfg.get_bool("rabit_spare"),
+        "shrink_after_sec": float(
+            cfg.get("rabit_shrink_after_sec", "0") or "0"),
+        "min_world": cfg.get_int("rabit_min_world", 1),
+        "promote_after_sec": float(
+            cfg.get("rabit_spare_promote_sec", "0.25") or "0.25"),
+    }

@@ -1,0 +1,539 @@
+"""Elastic worker harness — the Python client of the elastic tracker.
+
+One :class:`ElasticWorker` is a full protocol citizen of an elastic job
+(doc/elasticity.md): it binds a listen socket, checks in (``CMD_START``,
+or ``CMD_SPARE`` to park in the hot-spare pool), builds epoch-stamped
+ring links to its peers, and runs a deterministic iterate-allreduce-
+checkpoint loop whose collectives are **bitwise identical on every rank
+at every world size**: each round ring-allgathers the per-rank
+contributions and folds them in rank order (rank 0 first), so exact
+dtypes (integer histograms — the GBDT workload's shape) reproduce the
+same bits no matter how the world resized along the way.
+
+Failure shape: any link error mid-collective abandons the epoch — links
+close, the worker re-checks-in with ``CMD_RECOVER``, and the next wave
+(same size after a spare promotion, smaller after a shrink, larger after
+a grow-back) re-partitions the work via ``rabit_tpu.elastic.rebalance``
+and resumes from the last committed version.  State agreement after
+every wave is a version consensus plus a holder broadcast along the
+ring, mirroring the durable store's ``_disk_resume`` contract; a freshly
+promoted spare starts from the tracker's cached compressed bootstrap
+blob and is topped up the same way.
+
+The harness runs as threads (tests, chaos fuzzing, benches) or inside a
+process; everything socket is bounded, so "stuck" is an error, never a
+hang.  The native C++ engine keeps its fixed-world contract — elastic
+resizing at this layer is what the tracker's membership epochs enable
+for Python-side workloads, and the seam the engines hook via
+``rabit_tpu.api.rebootstrap``.
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable
+
+import numpy as np
+
+from rabit_tpu.elastic.rebalance import refold
+from rabit_tpu.obs.ship import Heartbeat, renew_lease
+from rabit_tpu.tracker import protocol as P
+
+
+class EpochBroken(Exception):
+    """The current epoch's links are unusable (peer died, stale epoch,
+    timeout): abandon the epoch and re-enter a wave."""
+
+
+class Rewave(Exception):
+    """The tracker asked for a re-wave at this version boundary (grow)."""
+
+
+@dataclass
+class ElasticResult:
+    task_id: str
+    completed: bool = False
+    died: bool = False
+    promoted: bool = False
+    parked_only: bool = False
+    final_version: int = 0
+    state: np.ndarray | None = None
+    epochs: list[int] = field(default_factory=list)
+    worlds: list[int] = field(default_factory=list)
+    error: str = ""
+
+
+class ElasticWorker:
+    """One elastic job participant (see module docstring).
+
+    ``contribution(version, world, rank) -> np.ndarray`` is the per-round
+    work: it must cover this rank's shard of the SAME logical dataset at
+    any world size (``rebalance.shard_slice`` is the canonical cut), with
+    a world-independent shape, so the rank-order fold reproduces the same
+    totals across resizes.  ``fail`` injects deterministic deaths for
+    chaos schedules: ``("die", v)`` exits silently before contributing to
+    version ``v``; ``("die_parked",)`` a spare that dies in the pool;
+    ``("die_promoted",)`` a spare that dies the instant it is promoted —
+    mid-promotion, before any link comes up.
+    """
+
+    def __init__(
+        self,
+        tracker: tuple[str, int],
+        task_id: str,
+        contribution: Callable[[int, int, int], np.ndarray],
+        niter: int,
+        *,
+        spare: bool = False,
+        heartbeat_sec: float = 0.0,
+        rpc_timeout: float = 2.0,
+        wave_timeout: float = 20.0,
+        link_timeout: float = 10.0,
+        deadline_sec: float = 60.0,
+        fail: tuple | None = None,
+    ):
+        self.tracker = (tracker[0], int(tracker[1]))
+        self.task_id = task_id
+        self.contribution = contribution
+        self.niter = int(niter)
+        self.spare = bool(spare)
+        self.heartbeat_sec = float(heartbeat_sec)
+        self.rpc_timeout = float(rpc_timeout)
+        self.wave_timeout = float(wave_timeout)
+        self.link_timeout = float(link_timeout)
+        self.deadline = time.monotonic() + float(deadline_sec)
+        self.fail = fail
+        self._stop = threading.Event()
+        self._listen = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listen.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listen.bind(("127.0.0.1", 0))
+        self._listen.listen(16)
+        self.listen_port = self._listen.getsockname()[1]
+        self._links: dict[int, socket.socket] = {}
+        self._hb: Heartbeat | None = None
+        self._rank = -1
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _check_deadline(self) -> None:
+        if self._stop.is_set():
+            raise EpochBroken("stopped")
+        if time.monotonic() > self.deadline:
+            raise TimeoutError(
+                f"elastic worker {self.task_id}: deadline exceeded")
+
+    # -- tracker RPCs --------------------------------------------------------
+
+    def _checkin(self, cmd: int, prev_rank: int) -> P.Assignment:
+        """START/RECOVER check-in on a raw socket: the reply is either an
+        Assignment (the wave closed with us in it) or a park frame (the
+        wave had no slot — we joined the spare pool; the SAME socket then
+        waits for promotion).  Transport failures and timed-out waves
+        retry — the tracker replaces a task id's stale pending entry on
+        re-check-in — until the worker deadline converts "stuck" into a
+        hard error."""
+        while True:
+            self._check_deadline()
+            sock = None
+            try:
+                sock = socket.create_connection(self.tracker,
+                                                timeout=self.rpc_timeout)
+                P.send_hello(sock, cmd, self.task_id, prev_rank=prev_rank,
+                             listen_port=self.listen_port)
+                asg = self._await_assignment(sock)
+                if asg is None:  # parked: wait for promotion, same socket
+                    asg = self._await_assignment(sock, parked=True)
+                if asg is not None:
+                    return asg
+            except (OSError, ValueError, ConnectionError, EpochBroken):
+                pass
+            finally:
+                # Safe on success too: the assignment was fully parsed and
+                # the tracker closes its end after sending.
+                if sock is not None:
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
+            time.sleep(0.05)
+
+    def _await_assignment(self, sock: socket.socket,
+                          parked: bool = False) -> P.Assignment | None:
+        """Wait (bounded, stop-aware) for the wave reply on ``sock``.
+        Returns the Assignment, or None when a park frame arrived
+        (``parked=False``) to signal "now in the pool"."""
+        end = min(time.monotonic() + self.wave_timeout, self.deadline)
+        while True:
+            self._check_deadline()
+            sock.settimeout(0.2)
+            try:
+                magic = P.get_u32(sock)
+            except socket.timeout:
+                if time.monotonic() > end and not parked:
+                    raise EpochBroken("wave reply timed out")
+                continue
+            sock.settimeout(self.link_timeout)
+            if magic == P.MAGIC_ASSIGN:
+                return self._finish_assignment(sock)
+            if magic == P.MAGIC_BLOB and not parked:
+                version = P.get_u32(sock)
+                n = P.get_u32(sock)
+                blob = P.recv_exact(sock, n) if n else b""
+                self._note_blob(version, blob)
+                return None
+            raise ValueError(f"unexpected wave reply magic {magic:#x}")
+
+    @staticmethod
+    def _finish_assignment(sock: socket.socket) -> P.Assignment:
+        """Parse the Assignment body after its magic was consumed."""
+        return P.Assignment.recv_body(sock)
+
+    def _park(self) -> P.Assignment | None:
+        """CMD_SPARE park: receive the cached bootstrap blob, then hold
+        the warm socket until promoted (Assignment), released (EOF at
+        job end), or told to die by the fail schedule."""
+        sock = socket.create_connection(self.tracker,
+                                        timeout=self.rpc_timeout)
+        try:
+            P.send_hello(sock, P.CMD_SPARE, self.task_id,
+                         listen_port=self.listen_port)
+            sock.settimeout(self.wave_timeout)
+            version, blob = P.recv_blob_frame(sock)
+            self._note_blob(version, blob)
+            if self.fail is not None and self.fail[0] == "die_parked":
+                raise EpochBroken("spare died while parked")
+            while True:
+                if self._stop.is_set() or time.monotonic() > self.deadline:
+                    return None
+                sock.settimeout(0.2)
+                try:
+                    magic = P.get_u32(sock)
+                except socket.timeout:
+                    continue
+                except (ConnectionError, OSError):
+                    return None  # tracker gone / job over: unused spare
+                sock.settimeout(self.link_timeout)
+                if magic != P.MAGIC_ASSIGN:
+                    return None
+                return self._finish_assignment(sock)
+        finally:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def _query_epoch(self) -> dict | None:
+        try:
+            info = P.tracker_rpc(
+                self.tracker[0], self.tracker[1], P.CMD_EPOCH, self.task_id,
+                prev_rank=self._rank, message=str(self._version),
+                timeout=self.rpc_timeout, retries=1)
+            return info if isinstance(info, dict) else None
+        except (P.TrackerUnreachable, ValueError):
+            return None
+
+    def _ship_blob(self) -> None:
+        """Rank 0 refreshes the tracker's spare bootstrap blob after each
+        commit: the (version, state) pickle, zlib-framed exactly like the
+        durable store's recovery blobs (rabit_tpu.compress)."""
+        from rabit_tpu.compress import get_codec
+
+        blob = get_codec("zlib").encode_bytes(
+            pickle.dumps((self._version, self._state),
+                         protocol=pickle.HIGHEST_PROTOCOL))
+        try:
+            with socket.create_connection(self.tracker,
+                                          timeout=self.rpc_timeout) as sock:
+                P.send_hello(sock, P.CMD_BLOB, self.task_id,
+                             blob=blob, blob_version=self._version)
+                P.get_u32(sock)  # ACK — best-effort, errors tolerated
+        except (OSError, ConnectionError, ValueError):
+            pass  # blob shipping must never fail the job
+
+    def _note_blob(self, version: int, blob: bytes) -> None:
+        if version <= 0 or not blob:
+            return
+        from rabit_tpu.compress import get_codec
+
+        try:
+            ver, state = pickle.loads(get_codec("zlib").decode_bytes(blob))
+        except Exception:  # noqa: BLE001 — a torn blob is only a cold start
+            return
+        if ver > self._version:
+            self._version, self._state = int(ver), state
+
+    # -- peer links ----------------------------------------------------------
+
+    def _build_links(self, asg: P.Assignment) -> None:
+        """Establish the epoch's ring links: lower rank dials, higher rank
+        accepts; the MAGIC_LINK handshake carries (rank, epoch) so stale
+        dialers from a previous epoch are dropped (the native engine's
+        exact contract, comm.cc BuildLinks)."""
+        self._close_links()
+        world = asg.world_size
+        if world <= 1:
+            return
+        neighbors = {asg.ring_prev, asg.ring_next} - {asg.rank}
+        expect_accept = {p for p in neighbors if p < asg.rank}
+        deadline = min(time.monotonic() + self.link_timeout, self.deadline)
+        for peer in sorted(p for p in neighbors if p > asg.rank):
+            host, port = asg.peers[peer]
+            try:
+                s = socket.create_connection((host, port),
+                                             timeout=self.link_timeout)
+                s.settimeout(self.link_timeout)
+                s.sendall(P.put_u32(P.MAGIC_LINK) + P.put_i32(asg.rank)
+                          + P.put_u32(asg.epoch))
+            except OSError as exc:
+                raise EpochBroken(f"dial to rank {peer} failed: {exc!r}")
+            self._links[peer] = s
+        while expect_accept:
+            if self._stop.is_set() or time.monotonic() > deadline:
+                raise EpochBroken(
+                    f"links from {sorted(expect_accept)} never arrived")
+            self._listen.settimeout(0.2)
+            try:
+                s, _ = self._listen.accept()
+            except socket.timeout:
+                continue
+            except OSError as exc:
+                raise EpochBroken(f"accept failed: {exc!r}")
+            try:
+                s.settimeout(self.link_timeout)
+                magic = P.get_u32(s)
+                peer = P.get_i32(s)
+                epoch = P.get_u32(s)
+            except (ConnectionError, OSError, socket.timeout):
+                s.close()
+                continue
+            if (magic != P.MAGIC_LINK or epoch != asg.epoch
+                    or peer not in expect_accept):
+                s.close()  # stale dialer from a previous epoch; drop
+                continue
+            self._links[peer] = s
+            expect_accept.discard(peer)
+
+    def _close_links(self) -> None:
+        for s in self._links.values():
+            try:
+                s.close()
+            except OSError:
+                pass
+        self._links.clear()
+
+    @staticmethod
+    def _send_frame(sock: socket.socket, payload: bytes) -> None:
+        try:
+            sock.sendall(P.put_u32(len(payload)) + payload)
+        except OSError as exc:
+            raise EpochBroken(f"link send failed: {exc!r}")
+
+    @staticmethod
+    def _recv_frame(sock: socket.socket) -> bytes:
+        try:
+            n = P.get_u32(sock)
+            return P.recv_exact(sock, n) if n else b""
+        except (ConnectionError, OSError, socket.timeout) as exc:
+            raise EpochBroken(f"link recv failed: {exc!r}")
+
+    # -- collectives ---------------------------------------------------------
+
+    def _ring_allgather(self, asg: P.Assignment,
+                        payload: bytes) -> list[bytes]:
+        """Every rank's payload, in RANK ORDER — world-1 ring hops (send
+        to ring_next, receive from ring_prev), then the caller folds
+        deterministically.  Payloads are small control-plane frames; both
+        ring directions of a 2-world share one socket, which is safe
+        because each hop sends before it receives and the frames fit the
+        kernel socket buffers."""
+        world = asg.world_size
+        blocks: dict[int, bytes] = {asg.rank: payload}
+        if world == 1:
+            return [payload]
+        nxt = self._links[asg.ring_next]
+        prv = self._links[asg.ring_prev]
+        outgoing = payload
+        for step in range(world - 1):
+            self._send_frame(nxt, outgoing)
+            incoming = self._recv_frame(prv)
+            blocks[(asg.rank - 1 - step) % world] = incoming
+            outgoing = incoming
+        return [blocks[r] for r in range(world)]
+
+    def _ring_broadcast(self, asg: P.Assignment, root: int,
+                        payload: bytes | None) -> bytes:
+        """Forward ``payload`` from ``root`` around the ring (world-1
+        hops); every rank receives the identical bytes."""
+        world = asg.world_size
+        if world == 1:
+            assert payload is not None
+            return payload
+        dist = (asg.rank - root) % world
+        if dist == 0:
+            assert payload is not None
+            self._send_frame(self._links[asg.ring_next], payload)
+            return payload
+        payload = self._recv_frame(self._links[asg.ring_prev])
+        if dist < world - 1:
+            self._send_frame(self._links[asg.ring_next], payload)
+        return payload
+
+    def _allreduce_sum(self, asg: P.Assignment,
+                       contrib: np.ndarray) -> np.ndarray:
+        """Rank-order fold of the allgathered contributions: bitwise
+        identical on every rank, and — for exact dtypes — identical
+        across world sizes that partition the same dataset."""
+        contrib = np.ascontiguousarray(contrib)
+        parts = self._ring_allgather(asg, contrib.tobytes())
+        return refold([np.frombuffer(b, dtype=contrib.dtype)
+                       .reshape(contrib.shape) for b in parts])
+
+    # -- state agreement -----------------------------------------------------
+
+    def _sync_state(self, asg: P.Assignment) -> None:
+        """Post-wave consensus: agree on the newest committed version and
+        top up every rank below it from the holder — the in-memory analog
+        of the durable store's ``_disk_resume`` (lowest-ranked holder
+        serves, the blob crosses the ring once)."""
+        vers = self._ring_allgather(
+            asg, np.array([self._version], np.int64).tobytes())
+        versions = [int(np.frombuffer(b, np.int64)[0]) for b in vers]
+        vmax = max(versions)
+        if vmax <= 0 or all(v == vmax for v in versions):
+            return
+        root = versions.index(vmax)
+        blob = (pickle.dumps((self._version, self._state),
+                             protocol=pickle.HIGHEST_PROTOCOL)
+                if asg.rank == root else None)
+        got = self._ring_broadcast(asg, root, blob)
+        if self._version < vmax:
+            self._version, self._state = pickle.loads(got)
+
+    # -- heartbeats ----------------------------------------------------------
+
+    def _start_heartbeat(self) -> None:
+        if self.heartbeat_sec <= 0 or self._hb is not None:
+            return
+        host, port = self.tracker
+
+        def tick() -> bool:
+            if self._stop.is_set():
+                return False
+            return renew_lease(host, port, self.task_id, self.heartbeat_sec,
+                               rank=self._rank)
+
+        self._hb = Heartbeat(self.heartbeat_sec, tick, immediate=True).start()
+
+    def _stop_heartbeat(self) -> None:
+        hb, self._hb = self._hb, None
+        if hb is not None:
+            hb.stop()
+
+    # -- the job loop --------------------------------------------------------
+
+    def run(self) -> ElasticResult:
+        res = ElasticResult(task_id=self.task_id)
+        self._version = 0
+        self._state: np.ndarray | None = None
+        try:
+            return self._run(res)
+        except P.TrackerUnreachable as exc:
+            res.error = repr(exc)
+            return res
+        except EpochBroken as exc:
+            res.error = repr(exc)
+            res.died = True
+            return res
+        except (ConnectionError, OSError) as exc:
+            # TimeoutError (the worker deadline AND socket timeouts) is an
+            # OSError subclass; a tracker already gone (job over before a
+            # late spare arrived) is a ConnectionError.  Either way: report,
+            # never propagate into the harness thread.
+            res.error = repr(exc)
+            return res
+        finally:
+            self._stop_heartbeat()
+            self._close_links()
+            try:
+                self._listen.close()
+            except OSError:
+                pass
+
+    def _run(self, res: ElasticResult) -> ElasticResult:
+        if self.spare:
+            asg = self._park()
+            if asg is None:
+                res.parked_only = True
+                res.died = (self.fail is not None
+                            and self.fail[0] == "die_parked")
+                return res
+            res.promoted = True
+            if self.fail is not None and self.fail[0] == "die_promoted":
+                # Mid-promotion death: the assignment landed but no link
+                # ever comes up — peers' link build fails and the next
+                # wave re-plans around this spare.
+                res.died = True
+                return res
+        else:
+            asg = self._checkin(P.CMD_START, -1)
+        while True:
+            self._rank = asg.rank
+            res.epochs.append(asg.epoch)
+            res.worlds.append(asg.world_size)
+            try:
+                self._build_links(asg)
+                self._sync_state(asg)
+                self._start_heartbeat()
+                while self._version < self.niter:
+                    v = self._version + 1
+                    if (self.fail is not None and self.fail[0] == "die"
+                            and v >= self.fail[1]):
+                        # Silent death: heartbeats stop, every socket
+                        # closes — peers hit EpochBroken, the lease
+                        # expires, and the membership layer takes over.
+                        self._stop_heartbeat()
+                        self._close_links()
+                        res.died = True
+                        res.final_version = self._version
+                        res.state = self._state
+                        return res
+                    self._check_deadline()
+                    contrib = np.ascontiguousarray(
+                        self.contribution(v, asg.world_size, asg.rank))
+                    total = self._allreduce_sum(asg, contrib)
+                    self._state = (total if self._state is None
+                                   else self._state + total)
+                    self._version = v
+                    if asg.rank == 0:
+                        self._ship_blob()
+                    if self._version < self.niter:
+                        info = self._query_epoch()
+                        if info is not None and info.get("rewave"):
+                            raise Rewave()
+                break  # all versions committed
+            except Rewave:
+                self._close_links()
+                asg = self._checkin(P.CMD_RECOVER, asg.rank)
+            except EpochBroken:
+                self._check_deadline()
+                self._close_links()
+                asg = self._checkin(P.CMD_RECOVER, asg.rank)
+        # Clean shutdown handshake (tracker job accounting).
+        self._stop_heartbeat()
+        try:
+            P.tracker_rpc(self.tracker[0], self.tracker[1], P.CMD_SHUTDOWN,
+                          self.task_id, prev_rank=asg.rank,
+                          timeout=self.rpc_timeout, retries=1)
+        except (P.TrackerUnreachable, ValueError):
+            pass
+        res.completed = True
+        res.final_version = self._version
+        res.state = self._state
+        return res

@@ -1,0 +1,192 @@
+"""Membership epochs — the elastic world's single source of truth.
+
+rabit's recovery contract restores the *same* world size: a wave blocks
+until ``world_size`` ranks re-check-in, so a preempted worker with no
+replacement capacity stalls the job forever.  The production answer
+(PAPERS.md: *Highly Available Data Parallel ML training on Mesh
+Networks*) is an elastic membership layer: the job's composition is a
+monotonically increasing **world epoch** ``(epoch, world_size,
+rank_map)``, and a recovery wave may close at a *different* world size
+than it opened —
+
+* **promote** — a parked hot spare fills the dead rank's slot and the
+  wave closes at the same size, within one wave;
+* **shrink** — no spare arrives within ``shrink_after_sec``: the wave
+  closes with the survivors only, ranks reassigned densely;
+* **grow** — the world is below its launch size and spares are parked:
+  the next wave (entered by workers at a version boundary, so
+  checkpoint semantics stay intact) re-admits them up to ``base_world``.
+
+This module is the pure decision core: no sockets, no threads, no
+tracker state — the tracker (rabit_tpu/tracker/tracker.py) feeds it
+check-in counts and wave ages and commits the waves it closes, and
+tests drive it directly.  See doc/elasticity.md for the state machine.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Mapping
+
+#: decide() actions.
+WAIT = "wait"
+CLOSE = "close"
+
+
+@dataclass(frozen=True)
+class WorldEpoch:
+    """One committed membership generation.  ``rank_map`` is the full
+    task-id -> rank assignment of the wave that opened this epoch (the
+    authoritative map a late joiner needs; deltas derive from comparing
+    consecutive epochs, see :func:`rank_map_delta`)."""
+
+    epoch: int
+    world_size: int
+    rank_map: Mapping[str, int] = field(default_factory=dict)
+
+
+@dataclass(frozen=True)
+class WaveDecision:
+    """What to do with a pending wave right now.
+
+    ``action`` is ``WAIT`` (keep collecting check-ins) or ``CLOSE``.
+    On CLOSE, ``world`` is the world size to close at and
+    ``take_spares`` how many parked spares to promote into the wave
+    first.  ``resized`` is ``world - previous world`` (negative =
+    shrink, positive = grow, 0 = steady)."""
+
+    action: str
+    world: int = 0
+    take_spares: int = 0
+    resized: int = 0
+
+
+def rank_map_delta(prev: Mapping[str, int],
+                   new: Mapping[str, int]) -> dict:
+    """The membership delta between two epochs' rank maps:
+    ``{"joined": {task: rank}, "left": {task: old_rank},
+    "moved": {task: [old_rank, new_rank]}}`` — what an epoch-stamped
+    assignment reply summarizes for consumers that tracked the previous
+    epoch."""
+    joined = {t: r for t, r in new.items() if t not in prev}
+    left = {t: r for t, r in prev.items() if t not in new}
+    moved = {t: [prev[t], r] for t, r in new.items()
+             if t in prev and prev[t] != r}
+    return {"joined": joined, "left": left, "moved": moved}
+
+
+class MembershipManager:
+    """Owns the world-epoch line for one job.
+
+    Not thread-safe by itself — the tracker calls it under its own lock
+    (every method is pure computation over small dicts).  ``base_world``
+    is the launch size and the grow-back target; ``current`` is the
+    latest committed :class:`WorldEpoch` (epoch -1, the launch size, and
+    an empty rank map before the first wave commits).
+    """
+
+    def __init__(self, base_world: int, *, min_world: int = 1,
+                 shrink_after_sec: float = 0.0,
+                 promote_after_sec: float = 0.25):
+        if base_world < 1:
+            raise ValueError(f"base_world must be >= 1, got {base_world}")
+        self.base_world = int(base_world)
+        self.min_world = max(int(min_world), 1)
+        self.shrink_after_sec = float(shrink_after_sec)
+        self.promote_after_sec = float(promote_after_sec)
+        self.current = WorldEpoch(-1, self.base_world, {})
+        #: committed epochs, oldest first (telemetry's resize timeline).
+        self.history: list[WorldEpoch] = []
+
+    # -- accessors ----------------------------------------------------------
+
+    @property
+    def epoch(self) -> int:
+        return self.current.epoch
+
+    @property
+    def world(self) -> int:
+        return self.current.world_size
+
+    def grow_wanted(self, n_spares: int) -> bool:
+        """True when the world is below its launch size and parked spares
+        could fill it — the flag the tracker's epoch-query reply carries
+        so workers re-enter a wave at their next version boundary."""
+        return n_spares > 0 and self.world < self.base_world
+
+    # -- the wave decision ---------------------------------------------------
+
+    def decide(self, n_pending: int, n_spares: int,
+               wave_age: float) -> WaveDecision:
+        """Close, promote-and-close, shrink-and-close, or wait.
+
+        ``n_pending`` live check-ins are waiting on the current wave,
+        ``n_spares`` live spares are parked, and the wave has been
+        forming for ``wave_age`` seconds.  Precedence:
+
+        1. grow back toward ``base_world`` when check-ins + spares
+           exceed the current (shrunk) world;
+        2. close steady when the wave is full;
+        3. promote parked spares into missing slots once the wave has
+           been short for ``promote_after_sec`` (a grace so a slow but
+           live worker's own check-in wins the slot);
+        4. shrink to the survivors once ``shrink_after_sec`` passes with
+           no spare to promote (0 disables shrinking — the legacy
+           block-forever contract);
+        5. otherwise wait.
+        """
+        if n_pending <= 0:
+            return WaveDecision(WAIT)
+        target = self.world
+        # 1. grow: a wave below base_world absorbs surplus check-ins and
+        # parked spares up to the launch size.
+        if self.world < self.base_world:
+            reachable = min(self.base_world, n_pending + n_spares)
+            if reachable > target:
+                target = reachable
+        if n_pending >= target:
+            return WaveDecision(CLOSE, world=target,
+                                take_spares=0,
+                                resized=target - self.world)
+        missing = target - n_pending
+        # 3. promote: fill the hole from the spare pool within one wave.
+        if n_spares > 0 and wave_age >= self.promote_after_sec:
+            take = min(missing, n_spares)
+            if n_pending + take >= target:
+                return WaveDecision(CLOSE, world=target, take_spares=take,
+                                    resized=target - self.world)
+            # partial fill: promote what exists, then fall through to the
+            # shrink clock for the remainder.
+            if (self.shrink_after_sec > 0
+                    and wave_age >= self.shrink_after_sec
+                    and n_pending + take >= self.min_world):
+                return WaveDecision(CLOSE, world=n_pending + take,
+                                    take_spares=take,
+                                    resized=n_pending + take - self.world)
+            return WaveDecision(WAIT)
+        # 4. shrink: the pool is empty past the deadline — close with the
+        # survivors and keep making progress.
+        if (self.shrink_after_sec > 0 and wave_age >= self.shrink_after_sec
+                and n_pending >= self.min_world):
+            return WaveDecision(CLOSE, world=n_pending,
+                                resized=n_pending - self.world)
+        return WaveDecision(WAIT)
+
+    # -- committing ----------------------------------------------------------
+
+    def commit(self, rank_map: Mapping[str, int],
+               world_size: int) -> tuple[WorldEpoch, dict]:
+        """Commit a closed wave as the next epoch.  Returns the new
+        :class:`WorldEpoch` and the :func:`rank_map_delta` against the
+        previous one.  The epoch number is monotonically increasing and
+        never reused — it stamps assignments, peer-link handshakes, and
+        RTC3 checkpoint frames."""
+        if sorted(rank_map.values()) != list(range(world_size)):
+            raise ValueError(
+                f"rank_map {dict(rank_map)!r} is not a dense assignment "
+                f"of world {world_size}")
+        prev = self.current
+        new = WorldEpoch(prev.epoch + 1, int(world_size), dict(rank_map))
+        self.current = new
+        self.history.append(new)
+        return new, rank_map_delta(prev.rank_map, new.rank_map)

@@ -1,0 +1,83 @@
+"""Shard rebalancing around membership holes.
+
+When the world shrinks (or grows back), each survivor's data shard must
+be re-cut so the job still covers the WHOLE dataset: rabit's GBDT
+histogram workload sums per-shard histograms, so a dead rank's rows
+silently vanishing from the fold is wrong-answers, not just lost
+capacity.  The dense contiguous partition here is the one partition
+every rank can recompute locally from ``(n_rows, world_size, rank)``
+alone — no coordination beyond the epoch's world size, which every rank
+already agrees on.
+
+Pure functions; wired through ``rabit_tpu.api.register_rebalance`` and
+``rabit_tpu.models.gbdt.elastic_shard`` (the GBDT histogram path), and
+used directly by the elastic worker harness and tests.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def shard_bounds(n_rows: int, world: int) -> list[tuple[int, int]]:
+    """Dense contiguous ``[lo, hi)`` row ranges per rank.  The remainder
+    rows go to the lowest ranks, so any two ranks' shard sizes differ by
+    at most one and every row belongs to exactly one rank at every world
+    size."""
+    if world < 1:
+        raise ValueError(f"world must be >= 1, got {world}")
+    if n_rows < 0:
+        raise ValueError(f"n_rows must be >= 0, got {n_rows}")
+    base, rem = divmod(n_rows, world)
+    bounds = []
+    lo = 0
+    for r in range(world):
+        hi = lo + base + (1 if r < rem else 0)
+        bounds.append((lo, hi))
+        lo = hi
+    return bounds
+
+
+def shard_slice(n_rows: int, world: int, rank: int) -> slice:
+    """This rank's rows under the dense partition (a ``slice`` so callers
+    can index numpy arrays / memmaps without copying)."""
+    if not 0 <= rank < world:
+        raise ValueError(f"rank {rank} outside 0..{world - 1}")
+    lo, hi = shard_bounds(n_rows, world)[rank]
+    return slice(lo, hi)
+
+
+def rebalance_plan(n_rows: int, old_world: int, new_world: int) -> dict:
+    """Row movement when the partition re-cuts from ``old_world`` to
+    ``new_world`` ranks: per new rank, which old ranks' ranges overlap
+    its new range (``sources``), and the total rows that change owners
+    (``moved_rows``) — the cost a shard-rebalance callback pays, surfaced
+    in benches and the ``shard_rebalanced`` event."""
+    old = shard_bounds(n_rows, old_world)
+    new = shard_bounds(n_rows, new_world)
+    sources: dict[int, list[tuple[int, int, int]]] = {}
+    moved = 0
+    for nr, (nlo, nhi) in enumerate(new):
+        parts = []
+        for orank, (olo, ohi) in enumerate(old):
+            lo, hi = max(nlo, olo), min(nhi, ohi)
+            if lo < hi:
+                parts.append((orank, lo, hi))
+                if orank != nr:
+                    moved += hi - lo
+        sources[nr] = parts
+    return {"moved_rows": moved, "sources": sources,
+            "old_world": old_world, "new_world": new_world}
+
+
+def refold(parts: list[np.ndarray]) -> np.ndarray:
+    """Rank-order fold of per-rank contributions — the deterministic fold
+    every elastic collective uses (rank 0 first, then 1, ...), so the
+    result is bitwise identical on every rank and reproducible at any
+    world size for exact dtypes (integer histograms)."""
+    if not parts:
+        raise ValueError("refold needs at least one contribution")
+    acc = np.array(parts[0], copy=True)
+    for p in parts[1:]:
+        acc = acc + p
+    return acc

@@ -169,6 +169,21 @@ class NativeEngine(Engine):
         self.obs_event("init_after_exception", backend=self._kind)
         self._check(self._lib.RabitInitAfterException(), "init_after_exception")
 
+    def rebootstrap(self) -> None:
+        """Re-bootstrap after a world-epoch change (rabit_tpu.elastic):
+        finalize the engine and check in again, adopting whatever
+        assignment — rank, world size, topology — the tracker's current
+        epoch hands out.  The native collective core keeps its fixed-world
+        contract WITHIN a bootstrap; resizing happens by re-entering one.
+        In-memory checkpoint replay state does not survive the finalize —
+        callers re-feed state from the durable store (rabit_checkpoint_dir)
+        or an application-level blob, exactly like a whole-job resume.
+        Invoked through ``rabit_tpu.api.rebootstrap``."""
+        self.obs_event("epoch_changed", backend=self._kind,
+                       world=self.get_world_size())
+        self._check(self._lib.RabitFinalize(), "finalize")
+        self.init()
+
     # -- topology ----------------------------------------------------------
 
     def get_rank(self) -> int:

@@ -93,6 +93,28 @@ class XlaEngine(Engine):
         self._mesh = None
         self._jits.clear()
 
+    def rebuild_mesh(self) -> None:
+        """Adopt a resized world (rabit_tpu.elastic): drop every compiled
+        artifact pinned to the old process mesh — the one-device-per-
+        process Mesh, the jitted reduce fns, the compressed-path pairs —
+        and re-read the process topology, so the next collective lowers
+        against the current world.  Invoked through
+        ``rabit_tpu.api.rebootstrap``."""
+        import jax
+
+        from rabit_tpu.parallel.mesh import resize_ring
+
+        old_world = max(getattr(self, "_world", 1), 1)
+        self._mesh = None
+        self._jits.clear()
+        self._cjits.clear()
+        self._rank = jax.process_index()
+        self._world = jax.process_count()
+        delta = resize_ring(old_world, max(self._world, 1))
+        self.obs_event("epoch_changed", world=self._world,
+                       links_added=len(delta["added"]),
+                       links_removed=len(delta["removed"]))
+
     def get_rank(self) -> int:
         return getattr(self, "_rank", 0)
 

@@ -518,6 +518,24 @@ def predict_proba(forest: Forest, xb: jax.Array, cfg: GBDTConfig) -> jax.Array:
     return jax.nn.sigmoid(predict_margin(forest, xb, cfg))
 
 
+# -- elastic sharding -------------------------------------------------------
+
+
+def elastic_shard(X: np.ndarray, y: np.ndarray, world: int,
+                  rank: int) -> tuple[np.ndarray, np.ndarray]:
+    """This rank's rows of the FULL dataset under the elastic dense
+    partition (rabit_tpu.elastic.rebalance) — the shard-rebalance hook of
+    the histogram deployment.  When the world resizes, every surviving
+    rank re-cuts with the new ``(world, rank)`` and the per-shard
+    histogram sums keep covering the whole dataset around the hole; wire
+    it to ``rabit_tpu.api.register_rebalance`` so the re-cut runs at every
+    adopted epoch (doc/elasticity.md)."""
+    from rabit_tpu.elastic.rebalance import shard_slice
+
+    sl = shard_slice(len(X), world, rank)
+    return X[sl], y[sl]
+
+
 # -- host-facing wrapper ---------------------------------------------------
 
 
@@ -569,6 +587,16 @@ class GBDT:
         self.forest = jax.tree.map(np.asarray, state.forest)
         self._state = state
         return self
+
+    def fit_shard(self, X: np.ndarray, y: np.ndarray, world: int,
+                  rank: int, warm_state: TrainState | None = None):
+        """Elastic-deployment fit: train on this rank's dense shard of the
+        FULL dataset (``elastic_shard``).  After a world resize, call again
+        with the new ``(world, rank)`` (and the recovered ``warm_state``)
+        — the re-cut shard plus the engine-allreduce hook keep histogram
+        sums covering every row at any world size."""
+        Xs, ys = elastic_shard(X, y, world, rank)
+        return self.fit(Xs, ys, warm_state=warm_state)
 
     def predict_margin(self, X: np.ndarray) -> np.ndarray:
         if self.forest is None:

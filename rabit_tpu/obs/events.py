@@ -84,6 +84,15 @@ KINDS: dict[str, str] = {
     "lease_expired": "heartbeat lease lapsed: task_id, rank, overdue",
     "snapshot_rejected": "CMD_METRICS snapshot with out-of-range rank",
     "metrics_snapshot": "CMD_METRICS snapshot accepted: rank, task_id",
+    # elastic worlds (rabit_tpu/elastic, doc/elasticity.md)
+    "spare_parked": "hot spare checked in and parked: task_id, blob_version",
+    "spare_dropped": "parked spare hung up; removed from the pool",
+    "spare_promoted": "spare filled a dead rank's slot: task_id, rank, epoch",
+    "world_shrunk": "wave closed below the previous world: from, to, lost",
+    "world_grown": "wave closed above the previous world: from, to, joined",
+    "bootstrap_blob": "tracker cached a spare bootstrap blob: version, nbytes",
+    "epoch_changed": "worker adopted a new world epoch: epoch, world",
+    "shard_rebalanced": "shard-rebalance callbacks ran for a resize",
 }
 
 

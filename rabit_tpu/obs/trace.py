@@ -302,13 +302,19 @@ _RANK_INSTANTS = {
     "engine_error", "checkpoint_commit", "load_checkpoint",
     "checkpoint_loaded", "version_bump", "init_after_exception",
     "engine_finalize", "engine_shutdown", "engine_ready",
+    "epoch_changed", "shard_rebalanced",
 }
 
-#: Tracker-side event kinds rendered as instants on the tracker track.
+#: Tracker-side event kinds rendered as instants on the tracker track —
+#: including the world-epoch boundaries of an elastic job (spare
+#: promotions, shrinks, grows), so a Perfetto timeline shows resizes
+#: alongside the recovery waves that caused them.
 _TRACKER_INSTANTS = {
     "lease_expired", "wave_purged", "failure_detected", "recover_stats",
     "recover_stats_final", "snapshot_rejected", "worker_recovered",
     "disk_resume", "metrics_snapshot",
+    "spare_parked", "spare_dropped", "spare_promoted",
+    "world_shrunk", "world_grown", "bootstrap_blob",
 }
 
 

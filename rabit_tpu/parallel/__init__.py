@@ -2,6 +2,7 @@
 
 from rabit_tpu.parallel.mesh import (
     create_mesh,
+    resize_ring,
     ring_perm,
     replicated,
     sharded_along,
@@ -27,6 +28,7 @@ from rabit_tpu.parallel.ring import (
 
 __all__ = [
     "create_mesh",
+    "resize_ring",
     "ring_perm",
     "replicated",
     "sharded_along",

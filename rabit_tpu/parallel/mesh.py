@@ -66,6 +66,22 @@ def ring_perm(n: int, shift: int = 1) -> list[tuple[int, int]]:
     return [(i, (i + shift) % n) for i in range(n)]
 
 
+def resize_ring(n_old: int, n_new: int, shift: int = 1) -> dict:
+    """Ring-topology rebuild for an elastic resize (doc/elasticity.md):
+    the new ppermute permutation for ``n_new`` mesh positions plus the
+    link delta against the ``n_old`` ring — the links a shrink/grow
+    actually has to (re-)establish; every other hop persists.  The delta
+    is what ``XlaEngine.rebuild_mesh`` consumers and the elastic benches
+    report as resize cost."""
+    if n_old < 1 or n_new < 1:
+        raise ValueError(f"ring sizes must be >= 1, got {n_old}->{n_new}")
+    old = set(ring_perm(n_old, shift))
+    new = ring_perm(n_new, shift)
+    return {"perm": new,
+            "added": sorted(set(new) - old),
+            "removed": sorted(old - set(new))}
+
+
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, PartitionSpec())
 

@@ -31,15 +31,22 @@ _KEEP = 2  # two-phase commit skews live ranks by at most one version
 # cover, or bit-rotted) reads as ABSENT, so resume degrades to an older
 # version or the holder-broadcast path instead of crashing on garbage.
 #
-# Two frame generations: RTC1 (uncompressed payload) and RTC2, which adds
+# Three frame generations: RTC1 (uncompressed payload), RTC2, which adds
 # a codec byte (rabit_tpu.compress ids) so spilled blobs land compressed
-# (rabit_checkpoint_compress, default zlib).  The crc covers the ENCODED
-# payload — integrity is checked before any decode touches the bytes —
-# and RTC1 frames from older jobs stay readable forever.
+# (rabit_checkpoint_compress, default zlib), and RTC3, which additionally
+# records the WORLD EPOCH (rabit_tpu.elastic) the committing membership
+# generation held — so a resume can tell which world size produced each
+# version and replay stays deterministic across an elastic resize.  The
+# crc covers the ENCODED payload — integrity is checked before any decode
+# touches the bytes — and older frames stay readable forever.  RTC3 is
+# written only when a nonzero epoch is recorded; epoch-0 jobs keep
+# emitting the bytes-identical RTC1/RTC2 frames older readers know.
 _MAGIC = b"RTC1"
 _HDR = struct.Struct("<4sII")
 _MAGIC2 = b"RTC2"
 _HDR2 = struct.Struct("<4sBxxxII")  # magic, codec id, pad, crc, enc len
+_MAGIC3 = b"RTC3"
+_HDR3 = struct.Struct("<4sBxxxIII")  # ..., crc, enc len, world epoch
 
 
 class CheckpointStore:
@@ -75,11 +82,14 @@ class CheckpointStore:
 
     # -- writes -------------------------------------------------------------
 
-    def save(self, version: int, gblob: bytes, lblob: bytes | None) -> None:
-        """Persist one committed checkpoint atomically; prune old versions."""
-        self._write(self._gpath(version), gblob)
+    def save(self, version: int, gblob: bytes, lblob: bytes | None,
+             epoch: int = 0) -> None:
+        """Persist one committed checkpoint atomically; prune old versions.
+        A nonzero ``epoch`` (elastic worlds) is recorded in the frame
+        header (RTC3) and read back by :meth:`epoch_of`."""
+        self._write(self._gpath(version), gblob, epoch=epoch)
         if lblob is not None:
-            self._write(self._lpath(version), lblob)
+            self._write(self._lpath(version), lblob, epoch=epoch)
         if version not in self._versions:
             self._versions.append(version)
             self._versions.sort()
@@ -89,8 +99,21 @@ class CheckpointStore:
                 p.unlink(missing_ok=True)
                 self._cache.pop(p, None)
 
-    def _write(self, path: Path, blob: bytes) -> None:
-        if self._codec is None:
+    def _write(self, path: Path, blob: bytes, epoch: int = 0) -> None:
+        if epoch > 0:
+            # Elastic job: the frame carries the committing world epoch.
+            # Codec id 0 (identity) keeps the layout uniform when the
+            # store is configured uncompressed.
+            codec_id, payload = 0, blob
+            if self._codec is not None:
+                from rabit_tpu.compress import observe
+
+                payload = self._codec.encode_bytes(blob)
+                observe(self._codec.name, raw=len(blob), wire=len(payload))
+                codec_id = self._codec.codec_id
+            header = _HDR3.pack(_MAGIC3, codec_id, zlib.crc32(payload),
+                                len(payload), epoch)
+        elif self._codec is None:
             header, payload = _HDR.pack(_MAGIC, zlib.crc32(blob),
                                         len(blob)), blob
         else:
@@ -147,7 +170,17 @@ class CheckpointStore:
         except FileNotFoundError:
             return None
         blob: bytes | None = None
-        if len(raw) >= _HDR2.size and raw[:4] == _MAGIC2:
+        if len(raw) >= _HDR3.size and raw[:4] == _MAGIC3:
+            _magic, codec_id, crc, n, _epoch = _HDR3.unpack_from(raw)
+            enc = raw[_HDR3.size:]
+            if len(enc) == n and zlib.crc32(enc) == crc:
+                from rabit_tpu.compress import get_codec_by_id
+
+                try:
+                    blob = get_codec_by_id(codec_id).decode_bytes(enc)
+                except (ValueError, zlib.error):
+                    blob = None
+        elif len(raw) >= _HDR2.size and raw[:4] == _MAGIC2:
             _magic, codec_id, crc, n = _HDR2.unpack_from(raw)
             enc = raw[_HDR2.size:]
             if len(enc) == n and zlib.crc32(enc) == crc:
@@ -169,6 +202,20 @@ class CheckpointStore:
             return None
         self._cache[path] = blob
         return blob
+
+    def epoch_of(self, version: int) -> int:
+        """World epoch recorded in the version's global frame (RTC3), 0
+        for pre-elastic frames (RTC1/RTC2) or missing/torn files — the
+        resume path uses it to tell which membership generation committed
+        each version."""
+        try:
+            with open(self._gpath(version), "rb") as f:
+                head = f.read(_HDR3.size)
+        except OSError:
+            return 0
+        if len(head) >= _HDR3.size and head[:4] == _MAGIC3:
+            return _HDR3.unpack_from(head)[4]
+        return 0
 
     def has(self, version: int) -> bool:
         """True only for a version whose global blob passes the integrity

@@ -13,9 +13,20 @@ failure detector calls back into the launcher when a worker goes silent
 SILENT hang (frozen process, preempted VM) into the ordinary death shape
 the restart path and the engines' wave-based recovery already handle.
 
+Elastic worlds (doc/elasticity.md): ``--spares K`` additionally launches K
+hot-spare processes (task ids ``s0..s{K-1}``, ``rabit_spare=1`` in their
+config environment) that park in the tracker's pool; ``--shrink-after S``
+lets recovery waves close shrunk when the pool is empty past S seconds.
+Spares are not restarted when they die and do not gate job completion.
+
+Bookkeeping is keyed by TASK ID (``restarts``/``returncodes`` dicts), not
+by spawn order: late-joining spares and shrunk worlds have no stable dense
+index, and the old fixed-size lists would IndexError the moment task
+``s0`` died or a world closed below its launch size.
+
 Usage:
     python -m rabit_tpu.tracker.launcher --num-workers 4 \
-        [--max-restarts 20] -- python worker_prog.py [args...]
+        [--max-restarts 20] [--spares K] -- python worker_prog.py [args...]
 """
 
 from __future__ import annotations
@@ -46,6 +57,13 @@ def cpu_worker_env() -> dict[str, str]:
     return {"PYTHONPATH": os.pathsep.join(parts)}
 
 
+def spare_task_id(i: int) -> str:
+    """Task id of the i-th hot spare (workers use the dense ``str(i)``
+    launcher numbering; spares must NOT — a spare is outside the dense
+    rank space until the tracker promotes it)."""
+    return f"s{i}"
+
+
 class LocalCluster:
     def __init__(
         self,
@@ -53,13 +71,25 @@ class LocalCluster:
         max_restarts: int = 0,
         quiet: bool = False,
         extra_env: dict[str, str] | None = None,
+        spares: int = 0,
+        shrink_after_sec: float = 0.0,
     ):
         self.num_workers = num_workers
         self.max_restarts = max_restarts
         self.quiet = quiet
         self.extra_env = extra_env or {}
-        self.restarts = [0] * num_workers
-        self.returncodes: list[int | None] = [None] * num_workers
+        self.num_spares = int(spares)
+        self.shrink_after_sec = float(shrink_after_sec)
+        #: per-task restart / last-returncode bookkeeping, keyed by TASK ID
+        #: (workers "0".."N-1", spares "s0".."sK-1") — dicts, not spawn-
+        #: order lists, so elastic membership cannot index out of range.
+        self.restarts: dict[str, int] = {
+            str(i): 0 for i in range(num_workers)}
+        self.returncodes: dict[str, int | None] = {
+            str(i): None for i in range(num_workers)}
+        for i in range(self.num_spares):
+            self.restarts[spare_task_id(i)] = 0
+            self.returncodes[spare_task_id(i)] = None
         self.messages: list[str] = []  # tracker print log of the last run
         # Structured observability of the last run (doc/observability.md):
         # tracker events (bootstrap/recovery waves, recover_stats converted
@@ -83,27 +113,34 @@ class LocalCluster:
         self.wedge_times: list[float] = []
         # task ids the tracker's lease monitor suspected; drained by the
         # poll loop, which SIGKILLs them (the monitor thread never touches
-        # procs[] directly — all process state stays on the run() thread)
+        # procs{} directly — all process state stays on the run() thread)
         self._suspects: list[str] = []
         self._suspect_lock = threading.Lock()
-        # indices whose death was already stamped into death_times by the
-        # preemption path (the restart branch must not stamp them twice)
-        self._death_stamped: set[int] = set()
+        # task ids whose death was already stamped into death_times by the
+        # preemption/suspect path (the reap branch must not stamp them
+        # twice — including a promoted spare later reaped dead)
+        self._death_stamped: set[str] = set()
 
     def _on_suspect(self, task_id: str) -> None:
         """Tracker lease-monitor callback (runs on the monitor thread)."""
         with self._suspect_lock:
             self._suspects.append(task_id)
 
-    def _spawn(self, cmd: list[str], tracker: Tracker, i: int) -> subprocess.Popen:
+    def _spawn(self, cmd: list[str], tracker: Tracker,
+               task_id: str, spare: bool = False) -> subprocess.Popen:
         env = dict(os.environ)
         env.update(self.extra_env)
         env.update(
             DMLC_TRACKER_URI=tracker.host,
             DMLC_TRACKER_PORT=str(tracker.port),
-            DMLC_TASK_ID=str(i),
-            DMLC_NUM_ATTEMPT=str(self.restarts[i]),
+            DMLC_TASK_ID=task_id,
+            DMLC_NUM_ATTEMPT=str(self.restarts[task_id]),
         )
+        if spare:
+            # config layer 2 (rabit_tpu/config.py): RABIT_TPU_* env wins
+            # over defaults, so the worker sees rabit_spare=1 without
+            # touching its argv.
+            env["RABIT_TPU_RABIT_SPARE"] = "1"
         return subprocess.Popen(cmd, env=env)
 
     def run(
@@ -113,9 +150,9 @@ class LocalCluster:
         preempt: list[tuple[float, int]] | None = None,
         wedge: list[tuple[float, int]] | None = None,
     ) -> int:
-        """Run ``cmd`` x num_workers under a fresh tracker.  Returns 0 when
-        every worker exited cleanly; raises on restart-budget exhaustion or
-        timeout.
+        """Run ``cmd`` x num_workers (+ spares) under a fresh tracker.
+        Returns 0 when every primary worker exited cleanly; raises on
+        restart-budget exhaustion or timeout.
 
         ``preempt`` schedules abrupt external deaths: ``[(delay_s, rank),
         ...]`` SIGKILLs that worker ``delay_s`` seconds after launch,
@@ -133,22 +170,29 @@ class LocalCluster:
         frozen worker, this launcher SIGKILLs it, and the hang becomes an
         ordinary recoverable death."""
         tracker = Tracker(self.num_workers, quiet=self.quiet,
-                          on_suspect=self._on_suspect).start()
+                          on_suspect=self._on_suspect,
+                          shrink_after_sec=self.shrink_after_sec).start()
         self.messages = tracker.messages
         self.events = tracker.events
-        procs = [self._spawn(cmd, tracker, i) for i in range(self.num_workers)]
+        primaries = [str(i) for i in range(self.num_workers)]
+        procs: dict[str, subprocess.Popen | None] = {
+            t: self._spawn(cmd, tracker, t) for t in primaries}
+        for i in range(self.num_spares):
+            sid = spare_task_id(i)
+            procs[sid] = self._spawn(cmd, tracker, sid, spare=True)
         start = time.monotonic()
         deadline = start + timeout
         pending = sorted(preempt or [], key=lambda p: p[0], reverse=True)
         wedges = sorted(wedge or [], key=lambda p: p[0], reverse=True)
-        reap_pending: set[int] = set()  # killed, reap deferred to poll loop
+        reap_pending: set[str] = set()  # killed, reap deferred to poll loop
         try:
             while True:
                 if time.monotonic() > deadline:
                     raise TimeoutError(f"cluster did not finish within {timeout}s")
                 while pending and time.monotonic() - start >= pending[-1][0]:
                     _, idx = pending[-1]
-                    proc = procs[idx]
+                    tid = str(idx)
+                    proc = procs.get(tid)
                     if proc is not None and proc.poll() is not None:
                         # Target died but hasn't been reaped/restarted yet:
                         # keep the entry queued so the kill lands on the
@@ -175,16 +219,16 @@ class LocalCluster:
                             # restart reap — recovery-latency benchmarks
                             # measure from the real preemption instant.
                             self.death_times.append(killed_at)
-                            self._death_stamped.add(idx)
+                            self._death_stamped.add(tid)
                     except subprocess.TimeoutExpired:
-                        reap_pending.add(idx)
+                        reap_pending.add(tid)
                     if not self.quiet:
-                        print(f"[launcher] preempted worker {idx} "
+                        print(f"[launcher] preempted worker {tid} "
                               f"(SIGKILL)", flush=True)
                 while wedges and time.monotonic() - start >= wedges[-1][0]:
                     _, idx = wedges[-1]
                     wedges.pop()
-                    proc = procs[idx]
+                    proc = procs.get(str(idx))
                     if proc is None or proc.poll() is not None:
                         continue  # already gone — nothing to freeze
                     proc.send_signal(signal.SIGSTOP)
@@ -196,66 +240,82 @@ class LocalCluster:
                 with self._suspect_lock:
                     suspects, self._suspects = self._suspects, []
                 for task_id in suspects:
-                    try:
-                        idx = int(task_id)
-                    except ValueError:
-                        continue  # not one of ours
-                    proc = procs[idx] if 0 <= idx < len(procs) else None
+                    proc = procs.get(task_id)
                     if proc is None or proc.poll() is not None:
                         continue  # already dead/finished; nothing to heal
                     # Convert the silent hang into a death: SIGKILL works on
                     # stopped processes too, peers get TCP resets, and the
-                    # normal restart/recovery path below takes over.
+                    # normal restart/recovery path below takes over.  Stamp
+                    # the death here (once — the reap branch checks the
+                    # stamp), so spare-promotion latency benchmarks measure
+                    # from the confirmed kill even for tasks that are never
+                    # restarted (spares, shrunk-away ranks).
                     proc.kill()
+                    self.death_times.append(time.time())
+                    self._death_stamped.add(task_id)
                     if not self.quiet:
-                        print(f"[launcher] worker {idx} suspected by lease "
-                              f"monitor: SIGKILL to force recovery",
+                        print(f"[launcher] worker {task_id} suspected by "
+                              f"lease monitor: SIGKILL to force recovery",
                               flush=True)
                 alive = 0
-                for i, proc in enumerate(procs):
+                for tid, proc in list(procs.items()):
                     if proc is None:
                         continue
+                    is_spare = not tid.isdigit()
                     ret = proc.poll()
-                    if ret is not None and i in reap_pending:
-                        reap_pending.discard(i)
+                    if ret is not None and tid in reap_pending:
+                        reap_pending.discard(tid)
                         if ret == -signal.SIGKILL:
                             self.preempts_delivered += 1
                             # Deferred-reap preemptions must land in
                             # death_times too; reap time is the closest
                             # observable stamp left.
                             self.death_times.append(time.time())
-                            self._death_stamped.add(i)
+                            self._death_stamped.add(tid)
                     if ret is None:
-                        alive += 1
+                        if not is_spare:
+                            alive += 1
                     elif ret == 0:
-                        self.returncodes[i] = 0
-                        procs[i] = None
+                        self.returncodes[tid] = 0
+                        procs[tid] = None
+                    elif is_spare:
+                        # A dead spare is not restarted and does not gate
+                        # completion: the pool shrank, nothing more.
+                        self.returncodes[tid] = ret
+                        procs[tid] = None
+                        if tid not in self._death_stamped:
+                            self.death_times.append(time.time())
+                            self._death_stamped.add(tid)
+                        if not self.quiet:
+                            print(f"[launcher] spare {tid} died "
+                                  f"(code {ret}); pool shrank", flush=True)
                     else:
                         # Worker died: the reference tracker restarts it and
                         # peers recover (doc/guide.md:338-374).
-                        if self.restarts[i] >= self.max_restarts:
+                        self.returncodes[tid] = ret
+                        if self.restarts[tid] >= self.max_restarts:
                             raise RuntimeError(
-                                f"worker {i} died with code {ret}; restart "
+                                f"worker {tid} died with code {ret}; restart "
                                 f"budget ({self.max_restarts}) exhausted"
                             )
-                        self.restarts[i] += 1
-                        if i in self._death_stamped:
-                            self._death_stamped.discard(i)
+                        self.restarts[tid] += 1
+                        if tid in self._death_stamped:
+                            self._death_stamped.discard(tid)
                         else:
                             self.death_times.append(time.time())
                         if not self.quiet:
                             print(
-                                f"[launcher] worker {i} died (code {ret}); "
-                                f"restart {self.restarts[i]}/{self.max_restarts}",
+                                f"[launcher] worker {tid} died (code {ret}); "
+                                f"restart {self.restarts[tid]}/{self.max_restarts}",
                                 flush=True,
                             )
-                        procs[i] = self._spawn(cmd, tracker, i)
+                        procs[tid] = self._spawn(cmd, tracker, tid)
                         alive += 1
                 if alive == 0:
                     return 0
                 time.sleep(0.02)
         finally:
-            for proc in procs:
+            for proc in procs.values():
                 if proc is not None and proc.poll() is None:
                     proc.kill()
                     proc.wait()
@@ -269,6 +329,17 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--max-restarts", type=int, default=0)
     ap.add_argument("--timeout", type=float, default=300.0)
     ap.add_argument("--quiet", action="store_true")
+    ap.add_argument(
+        "--spares", type=int, default=0, metavar="K",
+        help="launch K hot-spare processes (rabit_spare=1; task ids "
+             "s0..s{K-1}) that park in the tracker's pool and are promoted "
+             "into dead ranks' slots (doc/elasticity.md)",
+    )
+    ap.add_argument(
+        "--shrink-after", type=float, default=0.0, metavar="SEC",
+        help="let a recovery wave close SHRUNK when no spare fills the "
+             "hole within SEC seconds (0 = legacy block-until-full)",
+    )
     ap.add_argument(
         "--preempt", action="append", default=[], metavar="DELAY:RANK",
         help="SIGKILL worker RANK DELAY seconds after launch, wherever it "
@@ -304,7 +375,9 @@ def main(argv: list[str] | None = None) -> int:
 
     preempt = parse_schedule(args.preempt, "--preempt")
     wedge = parse_schedule(args.wedge, "--wedge")
-    cluster = LocalCluster(args.num_workers, args.max_restarts, quiet=args.quiet)
+    cluster = LocalCluster(args.num_workers, args.max_restarts,
+                           quiet=args.quiet, spares=args.spares,
+                           shrink_after_sec=args.shrink_after)
     return cluster.run(cmd, timeout=args.timeout, preempt=preempt, wedge=wedge)
 
 

@@ -13,10 +13,13 @@ Message layout (all u32/i32 little-endian; strings are u32 length + utf-8):
 worker -> tracker (fresh connection per message):
     u32 MAGIC_HELLO
     u32 cmd          (CMD_START | CMD_RECOVER | CMD_PRINT | CMD_SHUTDOWN
-                      | CMD_METRICS | CMD_HEARTBEAT)
+                      | CMD_METRICS | CMD_HEARTBEAT | CMD_SPARE
+                      | CMD_EPOCH | CMD_BLOB)
     i32 prev_rank    (-1 if never assigned; stable re-admission key is task_id)
     str task_id
-    if start/recover: u32 listen_port   (worker binds BEFORE contacting tracker)
+    if start/recover/spare: u32 listen_port (worker binds BEFORE contacting
+                      tracker; a spare parks on this connection and is
+                      answered with an Assignment only when promoted)
     if print:         str message
     if metrics:       str json_snapshot (rabit_tpu.obs.ship envelope; the
                       tracker folds it into the job-level telemetry.json)
@@ -24,6 +27,12 @@ worker -> tracker (fresh connection per message):
                       The tracker grants a lease of 2x this interval — one
                       missed renewal is tolerated, two expire the lease and
                       suspect the worker; see doc/fault_tolerance.md)
+    if epoch:         str version       (the worker's committed checkpoint
+                      version, informational — the poll elastic workers run
+                      at every version boundary, see doc/elasticity.md)
+    if blob:          u32 version, u32 nbytes, bytes — the current global
+                      model, already codec-compressed by the sender; the
+                      tracker caches the newest as the spare bootstrap blob
 
 tracker -> worker (start/recover reply, sent when the wave of world_size
 workers is complete):
@@ -34,9 +43,26 @@ workers is complete):
     u32 nchildren, i32 children...
     i32 ring_prev, i32 ring_next
     u32 npeers, each: i32 rank, str host, u32 port
-    u32 epoch        (bootstrap wave number; stamps peer-link handshakes)
+    u32 epoch        (world-epoch number; stamps peer-link handshakes)
+    u32 nmap, each: str task_id, i32 rank — the epoch's full rank_map
+                     (rabit_tpu.elastic.membership; the delta against the
+                     previous epoch derives by comparison, and a freshly
+                     promoted spare needs the whole map anyway).  The
+                     native C++ client (comm.cc RecvAssignment) reads up
+                     to the epoch and closes; the trailing map bytes are
+                     discarded with the connection, so both clients stay
+                     compatible with one tracker encoding.
 
-tracker -> worker (print/shutdown reply): u32 ACK
+tracker -> worker (spare reply, immediate): u32 MAGIC_BLOB, u32 version,
+    u32 nbytes, bytes — the cached compressed bootstrap blob (version 0 /
+    empty when nothing is cached yet).  The connection then stays open
+    ("warm socket"); promotion answers it with a normal Assignment.
+
+tracker -> worker (print/shutdown/blob reply): u32 ACK
+
+tracker -> worker (epoch reply): u32 ACK, str json — ``{"epoch": E,
+    "world": W, "rewave": bool}``; rewave asks the worker to re-enter a
+    wave at this version boundary (grow-back pending)
 
 tracker -> worker (metrics/heartbeat reply): u32 ACK, str server_ts — the
     tracker's ``time.time()`` stamped while answering.  The worker brackets
@@ -61,6 +87,7 @@ from dataclasses import dataclass, field
 MAGIC_HELLO = 0x7AB17001
 MAGIC_ASSIGN = 0x7AB17002
 MAGIC_LINK = 0x7AB17003
+MAGIC_BLOB = 0x7AB17004
 ACK = 0
 
 CMD_START = 1
@@ -69,6 +96,9 @@ CMD_PRINT = 3
 CMD_SHUTDOWN = 4
 CMD_METRICS = 5
 CMD_HEARTBEAT = 6
+CMD_SPARE = 7
+CMD_EPOCH = 8
+CMD_BLOB = 9
 
 #: How many renewal intervals a lease survives without a renewal.  2 means
 #: one lost/late heartbeat is tolerated; the second expires the lease, so a
@@ -129,6 +159,10 @@ class Assignment:
     ring_next: int
     peers: dict[int, tuple[str, int]] = field(default_factory=dict)
     epoch: int = 0
+    # The epoch's full task-id -> rank map (rabit_tpu.elastic).  Trails
+    # the epoch on the wire so the native client, which reads up to the
+    # epoch and closes, never sees it.
+    rank_map: dict[str, int] = field(default_factory=dict)
 
     def encode(self) -> bytes:
         out = [
@@ -144,6 +178,9 @@ class Assignment:
         for r, (host, port) in sorted(self.peers.items()):
             out += [put_i32(r), put_str(host), put_u32(port)]
         out.append(put_u32(self.epoch))
+        out.append(put_u32(len(self.rank_map)))
+        for task_id, r in sorted(self.rank_map.items()):
+            out += [put_str(task_id), put_i32(r)]
         return b"".join(out)
 
     @classmethod
@@ -151,6 +188,13 @@ class Assignment:
         magic = get_u32(sock)
         if magic != MAGIC_ASSIGN:
             raise ValueError(f"bad assignment magic {magic:#x}")
+        return cls.recv_body(sock)
+
+    @classmethod
+    def recv_body(cls, sock) -> "Assignment":
+        """Parse the fields after MAGIC_ASSIGN — for callers that dispatch
+        on the magic themselves (the elastic client's wave reply is either
+        an Assignment or a MAGIC_BLOB park frame)."""
         rank = get_i32(sock)
         world = get_u32(sock)
         parent = get_i32(sock)
@@ -164,7 +208,12 @@ class Assignment:
             port = get_u32(sock)
             peers[r] = (host, port)
         epoch = get_u32(sock)
-        return cls(rank, world, parent, children, ring_prev, ring_next, peers, epoch)
+        rank_map = {}
+        for _ in range(get_u32(sock)):
+            task_id = get_str(sock)
+            rank_map[task_id] = get_i32(sock)
+        return cls(rank, world, parent, children, ring_prev, ring_next,
+                   peers, epoch, rank_map)
 
 
 def tree_topology(rank: int, world: int) -> tuple[int, list[int]]:
@@ -181,13 +230,34 @@ def send_hello(
     prev_rank: int = -1,
     listen_port: int = 0,
     message: str = "",
+    blob: bytes = b"",
+    blob_version: int = 0,
 ) -> None:
     out = [put_u32(MAGIC_HELLO), put_u32(cmd), put_i32(prev_rank), put_str(task_id)]
-    if cmd in (CMD_START, CMD_RECOVER):
+    if cmd in (CMD_START, CMD_RECOVER, CMD_SPARE):
         out.append(put_u32(listen_port))
-    elif cmd in (CMD_PRINT, CMD_METRICS, CMD_HEARTBEAT):
+    elif cmd in (CMD_PRINT, CMD_METRICS, CMD_HEARTBEAT, CMD_EPOCH):
         out.append(put_str(message))
+    elif cmd == CMD_BLOB:
+        out += [put_u32(blob_version), put_u32(len(blob)), blob]
     send_all(sock, b"".join(out))
+
+
+def put_blob_frame(version: int, blob: bytes) -> bytes:
+    """The spare park reply: the cached compressed bootstrap blob behind
+    a MAGIC_BLOB header (version 0 / empty payload = nothing cached)."""
+    return b"".join([put_u32(MAGIC_BLOB), put_u32(version),
+                     put_u32(len(blob)), blob])
+
+
+def recv_blob_frame(sock) -> tuple[int, bytes]:
+    """Read one MAGIC_BLOB frame; returns (version, payload)."""
+    magic = get_u32(sock)
+    if magic != MAGIC_BLOB:
+        raise ValueError(f"bad blob magic {magic:#x}")
+    version = get_u32(sock)
+    n = get_u32(sock)
+    return version, recv_exact(sock, n) if n else b""
 
 
 class TimedAck(int):
@@ -260,11 +330,14 @@ def tracker_rpc(
     doesn't stampede the tracker); when the budget is exhausted the last
     error surfaces as :class:`TrackerUnreachable`.
 
-    Returns the :class:`Assignment` for START/RECOVER, the u32 ACK value
+    Returns the :class:`Assignment` for START/RECOVER, the parsed epoch
+    dict (``{"epoch", "world", "rewave"}``) for EPOCH, the u32 ACK value
     otherwise — as a :class:`TimedAck` (ACK plus the tracker's clock stamp
     and the local send/recv bracket) for METRICS/HEARTBEAT.  Retrying
     START/RECOVER is safe: the tracker replaces a task id's stale pending
-    entry on re-check-in (Tracker._register).
+    entry on re-check-in (Tracker._register).  SPARE does not ride this
+    path: its connection is long-lived by design (park-then-promote; see
+    rabit_tpu.elastic.client).
     """
     rng = rng if rng is not None else random
     retries = max(int(retries), 0)
@@ -286,6 +359,10 @@ def tracker_rpc(
                     # plus the local send/recv bracket is one clock sample
                     server_ts = float(get_str(sock))
                     return TimedAck(ack, server_ts, t_send, time.time())
+                if cmd == CMD_EPOCH:
+                    import json as _json
+
+                    return _json.loads(get_str(sock))
                 return ack
         except (ConnectionError, OSError) as exc:  # socket.timeout is OSError
             last_err = exc

@@ -25,6 +25,19 @@ or frozen process stops renewing, its lease expires after
 and invokes the pluggable ``on_suspect(task_id)`` callback.  The launcher
 wires that callback to SIGKILL-and-restart the suspect, converting a hang
 into the recoverable-death shape the wave-based recovery already handles.
+
+Elastic worlds (doc/elasticity.md): membership is a monotonically
+increasing world epoch ``(epoch, world_size, rank_map)`` owned by
+``rabit_tpu.elastic.MembershipManager``.  Workers launched with
+``rabit_spare=1`` check in with ``CMD_SPARE``, receive the cached
+compressed bootstrap blob (``CMD_BLOB`` uploads from rank 0), and park on
+a warm socket; a dead rank's slot is filled by promoting a parked spare
+into the recovery wave (``spare_promoted``), and when the pool is empty
+past ``shrink_after_sec`` the wave closes SHRUNK (``world_shrunk``) —
+ranks reassign densely and the job keeps making progress — then grows
+back (``world_grown``) when spares return, at the next version boundary
+(workers poll ``CMD_EPOCH`` between checkpoints and re-enter a wave when
+the reply carries ``rewave``).
 """
 
 from __future__ import annotations
@@ -37,6 +50,7 @@ import time
 from dataclasses import dataclass
 from typing import Callable
 
+from rabit_tpu.elastic.membership import CLOSE, MembershipManager
 from rabit_tpu.obs.events import event_from_stats_line
 from rabit_tpu.tracker import protocol as P
 
@@ -52,6 +66,7 @@ class _Pending:
     host: str
     prev_rank: int
     cmd: int = P.CMD_START
+    origin: str = "worker"  # "worker" | "spare" (promoted from the pool)
 
 
 def _conn_dead(conn: socket.socket) -> bool:
@@ -155,8 +170,23 @@ class Tracker:
                  host_order: list[str] | None = None,
                  obs_dir: str | None = None,
                  conn_timeout_sec: float = 60.0,
-                 on_suspect: Callable[[str], None] | None = None):
+                 on_suspect: Callable[[str], None] | None = None,
+                 shrink_after_sec: float = 0.0,
+                 min_world: int = 1,
+                 promote_after_sec: float = 0.25):
+        #: CURRENT world size — mutable under elastic membership (shrink/
+        #: grow); ``base_world`` is the launch size and grow-back target.
         self.world_size = world_size
+        self.base_world = world_size
+        # The membership manager owns the world-epoch line (epoch,
+        # world_size, rank_map) and every resize decision; the tracker
+        # feeds it check-in counts/wave ages under self._lock and mirrors
+        # the committed world into self.world_size.  shrink_after_sec=0
+        # keeps the legacy block-until-full contract.
+        self.elastic = MembershipManager(
+            world_size, min_world=min_world,
+            shrink_after_sec=shrink_after_sec,
+            promote_after_sec=promote_after_sec)
         self.quiet = quiet
         # Per-connection read deadline: a client that connects and sends a
         # torn/partial hello must not pin a _handle thread (and its socket)
@@ -199,9 +229,12 @@ class Tracker:
         self.host, self.port = self._srv.getsockname()
         self._lock = threading.Lock()
         self._pending: list[_Pending] = []
+        self._wave_started: float | None = None  # monotonic, first check-in
+        self._spares: list[_Pending] = []  # parked hot spares (warm sockets)
+        self._blob: tuple[int, bytes] | None = None  # (version, compressed)
         self._ranks: dict[str, int] = {}  # task_id -> stable rank
-        self._epoch = 0
         self._n_shutdown = 0
+        self._shutdown_tasks: set[str] = set()
         self._done = threading.Event()
         self._thread: threading.Thread | None = None
         self.messages: list[str] = []  # worker print log (also echoed)
@@ -213,6 +246,8 @@ class Tracker:
         self._thread.start()
         threading.Thread(target=self._lease_monitor, daemon=True,
                          name="rabit-tracker-leases").start()
+        threading.Thread(target=self._wave_monitor, daemon=True,
+                         name="rabit-tracker-waves").start()
         return self
 
     def wait(self, timeout: float | None = None) -> bool:
@@ -233,10 +268,26 @@ class Tracker:
             self._srv.close()
         except OSError:
             pass
+        self._release_spares()
         # Safety net for jobs torn down without a full shutdown wave (kill,
         # timeout): idempotent, so the normal all-ranks-shut-down path has
         # already written by the time stop() runs.
         self.write_telemetry()
+
+    def _release_spares(self) -> None:
+        """Release parked spares: their warm sockets EOF and the spare
+        workers exit their park loop instead of waiting out a deadline.
+        Runs at stop() AND the moment the job completes — a launcher-run
+        spare process (or a surplus-parked restarted worker) must exit as
+        soon as the last primary shuts down, not when the launcher tears
+        the tracker down."""
+        with self._lock:
+            spares, self._spares = self._spares, []
+        for sp in spares:
+            try:
+                sp.conn.close()
+            except OSError:
+                pass
 
     # -- serving -----------------------------------------------------------
 
@@ -279,7 +330,40 @@ class Tracker:
                                cmd)
                 # conn is answered (and closed) by the wave completer.
                 return
-            if cmd == P.CMD_PRINT:
+            if cmd == P.CMD_SPARE:
+                listen_port = P.get_u32(conn)
+                conn.settimeout(None)
+                self._park_spare(conn, addr[0], task_id, listen_port,
+                                 prev_rank)
+                # conn stays open (the warm socket); promotion answers it.
+                return
+            if cmd == P.CMD_EPOCH:
+                # The version-boundary poll: the worker's committed version
+                # rides as the payload (informational); the reply carries
+                # the current epoch and the rewave flag that triggers the
+                # grow-back wave (doc/elasticity.md).
+                P.get_str(conn)
+                with self._lock:
+                    self._reap_spares_locked()
+                    info = {"epoch": self.elastic.epoch,
+                            "world": self.world_size,
+                            "rewave": self.elastic.grow_wanted(
+                                len(self._spares))}
+                conn.sendall(P.put_u32(P.ACK) + P.put_str(json.dumps(info)))
+            elif cmd == P.CMD_BLOB:
+                version = P.get_u32(conn)
+                nbytes = P.get_u32(conn)
+                data = P.recv_exact(conn, nbytes) if nbytes else b""
+                with self._lock:
+                    if self._blob is None or version >= self._blob[0]:
+                        self._blob = (version, data)
+                    self.events.append({
+                        "ts": round(time.time(), 6),
+                        "kind": "bootstrap_blob", "task_id": task_id,
+                        "version": version, "nbytes": nbytes,
+                    })
+                conn.sendall(P.put_u32(P.ACK))
+            elif cmd == P.CMD_PRINT:
                 msg = P.get_str(conn)
                 self.messages.append(msg)
                 # Legacy-line bridge: the robust engine's recover_stats /
@@ -313,12 +397,23 @@ class Tracker:
                 done = False
                 with self._lock:
                     self._n_shutdown += 1
-                    done = self._n_shutdown >= self.world_size
+                    self._shutdown_tasks.add(task_id)
+                    # Elastic guard on the completion condition: a shrunk
+                    # world can reach n_shutdown >= world_size while OTHER
+                    # workers still hold live leases (they detected the
+                    # failure later and are re-waving toward their own
+                    # epoch).  The job is done only when no leased task
+                    # remains un-shut-down — a dead task's lease expires
+                    # and releases the guard on its own.
+                    done = (self._n_shutdown >= self.world_size
+                            and not (set(self._leases)
+                                     - self._shutdown_tasks))
                 if done:
                     # Persist BEFORE releasing wait()ers: by the time the
                     # launcher sees the job done, telemetry.json exists.
                     self.write_telemetry()
                     self._done.set()
+                    self._release_spares()
             conn.close()
         except (ConnectionError, OSError, ValueError):
             try:
@@ -347,31 +442,276 @@ class Tracker:
             self._pending = [p for p in self._pending if p.task_id != task_id]
             self._pending.append(
                 _Pending(conn, task_id, listen_port, host, prev_rank, cmd))
-            if len(self._pending) < self.world_size:
+            if self._wave_started is None:
+                self._wave_started = time.monotonic()
+            plan = self._close_wave_locked(timer=False)
+        if plan is not None:
+            self._send_wave(plan)
+
+    def _park_spare(self, conn, host, task_id, listen_port,
+                    prev_rank) -> None:
+        """CMD_SPARE: hand the spare the cached compressed bootstrap blob
+        and park its connection in the pool.  The warm socket is answered
+        with an Assignment when the spare is promoted into a wave."""
+        with self._lock:
+            self._leases.pop(task_id, None)
+            version, blob = self._blob if self._blob is not None else (0, b"")
+        try:
+            conn.sendall(P.put_blob_frame(version, blob))
+        except OSError:
+            try:
+                conn.close()
+            except OSError:
+                pass
+            return
+        with self._lock:
+            for stale in (s for s in self._spares if s.task_id == task_id):
+                try:
+                    stale.conn.close()
+                except OSError:
+                    pass
+            self._spares = [s for s in self._spares if s.task_id != task_id]
+            self._spares.append(_Pending(conn, task_id, listen_port, host,
+                                         prev_rank, P.CMD_START,
+                                         origin="spare"))
+            self.events.append({
+                "ts": round(time.time(), 6), "kind": "spare_parked",
+                "task_id": task_id, "blob_version": version,
+                "pool": len(self._spares),
+            })
+        if not self.quiet:
+            print(f"[tracker] spare {task_id} parked "
+                  f"(blob v{version}, pool {len(self._spares)})", flush=True)
+
+    # -- elastic wave machinery --------------------------------------------
+
+    def _purge_dead_locked(self) -> None:
+        """Drop pending check-ins whose worker hung up: a dead socket would
+        receive its assignment into the void, wasting the whole wave — and
+        a shrink decision must count live survivors only."""
+        dead = [p for p in self._pending if _conn_dead(p.conn)]
+        if not dead:
+            return
+        for p in dead:
+            try:
+                p.conn.close()
+            except OSError:
+                pass
+        self._pending = [p for p in self._pending if p not in dead]
+        self.events.append({
+            "ts": round(time.time(), 6), "kind": "wave_purged",
+            "dropped": sorted(p.task_id for p in dead),
+        })
+
+    def _reap_spares_locked(self) -> None:
+        """Drop parked spares whose warm socket hung up (a spare can die
+        while parked — it must not be counted by promote/grow decisions or
+        promoted into a dead socket)."""
+        dead = [s for s in self._spares if _conn_dead(s.conn)]
+        if not dead:
+            return
+        for s in dead:
+            try:
+                s.conn.close()
+            except OSError:
+                pass
+        self._spares = [s for s in self._spares if s not in dead]
+        self.events.append({
+            "ts": round(time.time(), 6), "kind": "spare_dropped",
+            "dropped": sorted(s.task_id for s in dead),
+        })
+
+    def _close_wave_locked(self, timer: bool) -> dict | None:
+        """Try to close the pending wave under the tracker lock.
+
+        ``timer=False`` is the check-in path: only the legacy full-wave
+        close (or an elastic grow absorbing spares/surplus) fires, so the
+        non-elastic contract is byte-for-byte the old behavior.
+        ``timer=True`` is the wave monitor / lease path and additionally
+        applies the age-gated decisions (spare promotion, shrink).
+
+        Returns a send plan (assignments + surplus parks) executed by
+        :meth:`_send_wave` OUTSIDE the lock, or None to keep waiting."""
+        if not self._pending:
+            self._wave_started = None
+            return None
+        elastic_active = (bool(self._spares)
+                          or self.elastic.shrink_after_sec > 0
+                          or self.world_size < self.base_world)
+        if timer and not elastic_active:
+            return None
+        age = time.monotonic() - (self._wave_started or time.monotonic())
+        if len(self._pending) >= self.world_size or (timer and elastic_active):
+            self._purge_dead_locked()
+        if timer:
+            self._reap_spares_locked()
+        if not self._pending:
+            self._wave_started = None
+            return None
+        decision = self.elastic.decide(
+            len(self._pending), len(self._spares) if elastic_active else 0,
+            age)
+        if decision.action != CLOSE:
+            return None
+        # Promote parked spares into the wave's empty slots.
+        for _ in range(decision.take_spares):
+            if not self._spares:
+                break
+            sp = self._spares.pop(0)
+            sp.cmd = P.CMD_START
+            self._pending.append(sp)
+        world = decision.world
+        # Membership selection: survivors already holding a rank keep
+        # priority; surplus check-ins (a restarted worker racing a
+        # promoted spare for one slot) are parked as spares.
+        ordered = sorted(
+            range(len(self._pending)),
+            key=lambda i: (not (0 <= self._ranks.get(
+                self._pending[i].task_id, -1) < world), i))
+        members = [self._pending[i] for i in sorted(ordered[:world])]
+        surplus = [self._pending[i] for i in sorted(ordered[world:])]
+        self._pending = []
+        self._wave_started = None
+        # Pool provenance, not take_spares, decides what counts as a
+        # promotion: note_dead pre-stages a spare into _pending directly
+        # (take_spares 0), and a taken spare can still land in surplus.
+        promoted = [p.task_id for p in members if p.origin == "spare"]
+        # Rank assignment + membership commit: stable re-admission >
+        # launcher numbering > host-grouped fill (assign_ranks), then the
+        # MembershipManager stamps the next world epoch.
+        self._ranks.update(assign_ranks(
+            [(p.task_id, p.host) for p in members], world, self._ranks,
+            host_order=self.host_order))
+        rank_map = {p.task_id: self._ranks[p.task_id] for p in members}
+        prev_world = self.world_size
+        prev_map = dict(self.elastic.current.rank_map)
+        wepoch, delta = self.elastic.commit(rank_map, world)
+        self.world_size = world
+        ts = round(time.time(), 6)
+        restarted = []
+        for p in members:
+            if p.cmd == P.CMD_START:
+                n_seen = self._n_starts.get(p.task_id, 0)
+                self._n_starts[p.task_id] = n_seen + 1
+                if n_seen > 0:
+                    restarted.append(p.task_id)
+        self.events.append({
+            "ts": ts,
+            "kind": "wave",
+            "epoch": wepoch.epoch,
+            "world": world,
+            "assignments": dict(rank_map),
+            "recovering": sorted(p.task_id for p in members
+                                 if p.cmd == P.CMD_RECOVER),
+            "restarted": sorted(restarted),
+            "delta": delta,
+        })
+        for task_id in promoted:
+            self.events.append({
+                "ts": ts, "kind": "spare_promoted", "task_id": task_id,
+                "rank": rank_map[task_id], "epoch": wepoch.epoch,
+            })
+        if world < prev_world:
+            self.events.append({
+                "ts": ts, "kind": "world_shrunk", "epoch": wepoch.epoch,
+                "from": prev_world, "to": world,
+                "lost": sorted(t for t in prev_map if t not in rank_map),
+            })
+        elif world > prev_world:
+            self.events.append({
+                "ts": ts, "kind": "world_grown", "epoch": wepoch.epoch,
+                "from": prev_world, "to": world,
+                "joined": sorted(delta["joined"]),
+            })
+        return {"members": members, "world": world, "epoch": wepoch.epoch,
+                "rank_map": rank_map, "surplus": surplus,
+                "promoted": promoted, "resized": decision.resized}
+
+    def _wave_monitor(self) -> None:
+        """Age-gated wave decisions (spare promotion, shrink, grow): scan
+        the pending wave and close it when the membership manager says so.
+        A no-op for non-elastic jobs (no spares, shrinking disabled)."""
+        while not self._done.wait(0.05):
+            with self._lock:
+                plan = self._close_wave_locked(timer=True)
+            if plan is not None:
+                self._send_wave(plan)
+
+    def note_dead(self, task_id: str) -> None:
+        """Fast-path promotion hook: a task known dead (lease expired,
+        launcher-confirmed kill) pre-stages a parked spare into the forming
+        wave, so the recovery wave closes the moment the survivors arrive
+        — promotion within ONE wave instead of one wave plus a timeout."""
+        with self._lock:
+            if any(p.task_id == task_id for p in self._pending):
+                return  # it is checking in right now — not actually dead
+            self._reap_spares_locked()
+            if not self._spares:
                 return
-            # The wave is nominally full — but a worker that died or gave
-            # up after checking in would receive its assignment into a dead
-            # socket, wasting the whole wave and starving its own retry out
-            # of the next one.  Purge hung-up entries first; their tasks'
-            # re-check-ins complete a later, fully live wave.
-            dead = [p for p in self._pending if _conn_dead(p.conn)]
-            if dead:
-                for p in dead:
-                    try:
-                        p.conn.close()
-                    except OSError:
-                        pass
-                self._pending = [p for p in self._pending if p not in dead]
+            sp = self._spares.pop(0)
+            sp.cmd = P.CMD_START
+            self._pending.append(sp)
+            if self._wave_started is None:
+                self._wave_started = time.monotonic()
+            plan = self._close_wave_locked(timer=True)
+        if plan is not None:
+            self._send_wave(plan)
+
+    def _send_wave(self, plan: dict) -> None:
+        """Deliver a closed wave: one Assignment per member (the epoch and
+        the full rank_map ride along), park replies to surplus check-ins.
+        Runs OUTSIDE the tracker lock — sends must not serialize against
+        check-in handling."""
+        world = plan["world"]
+        peers = {plan["rank_map"][p.task_id]: (p.host, p.listen_port)
+                 for p in plan["members"]}
+        for p in plan["members"]:
+            rank = plan["rank_map"][p.task_id]
+            parent, children = P.tree_topology(rank, world)
+            asg = P.Assignment(
+                rank=rank,
+                world_size=world,
+                parent=parent,
+                children=children,
+                ring_prev=(rank - 1) % world,
+                ring_next=(rank + 1) % world,
+                peers=peers,
+                epoch=plan["epoch"],
+                rank_map=plan["rank_map"],
+            )
+            try:
+                p.conn.sendall(asg.encode())
+            except OSError:
+                pass  # worker died mid-bootstrap; next wave will handle it
+            finally:
+                try:
+                    p.conn.close()
+                except OSError:
+                    pass
+        for p in plan["surplus"]:
+            # A live check-in the wave had no slot for: park it as a spare
+            # (the park reply — a MAGIC_BLOB frame — tells an elastic
+            # client "you are pooled"; promotion answers the same socket).
+            with self._lock:
+                version, blob = (self._blob if self._blob is not None
+                                 else (0, b""))
+            try:
+                p.conn.sendall(P.put_blob_frame(version, blob))
+            except OSError:
+                try:
+                    p.conn.close()
+                except OSError:
+                    pass
+                continue
+            p.origin = "spare"
+            p.cmd = P.CMD_START
+            with self._lock:
+                self._spares.append(p)
                 self.events.append({
-                    "ts": round(time.time(), 6), "kind": "wave_purged",
-                    "dropped": sorted(p.task_id for p in dead),
+                    "ts": round(time.time(), 6), "kind": "spare_parked",
+                    "task_id": p.task_id, "blob_version": version,
+                    "pool": len(self._spares),
                 })
-                if len(self._pending) < self.world_size:
-                    return
-            wave, self._pending = self._pending, []
-            epoch = self._epoch
-            self._epoch += 1
-        self._assign_and_send(wave, epoch)
 
     # -- liveness ----------------------------------------------------------
 
@@ -418,6 +758,22 @@ class Tracker:
                         self.on_suspect(task_id)
                     except Exception:  # noqa: BLE001 — detection must survive
                         pass
+                # Elastic fast path: a confirmed-dead task's slot is filled
+                # by pre-staging a parked spare into the forming recovery
+                # wave — promotion within one wave (doc/elasticity.md).
+                self.note_dead(task_id)
+            if expired:
+                # An expired lease may have been the last thing holding the
+                # completion guard (every shut-down rank already counted):
+                # re-evaluate, or wait() would hang on a dead straggler.
+                with self._lock:
+                    done = (0 < self.world_size <= self._n_shutdown
+                            and not (set(self._leases)
+                                     - self._shutdown_tasks))
+                if done:
+                    self.write_telemetry()
+                    self._done.set()
+                    self._release_spares()
 
     def live_tasks(self) -> list[str]:
         """Task ids currently holding an unexpired lease."""
@@ -438,7 +794,10 @@ class Tracker:
             rank = int(snap.get("rank", -1))
         except (ValueError, TypeError):
             return  # malformed snapshot must not hurt the tracker
-        if not 0 <= rank < self.world_size:
+        # Validate against the LARGEST world this job has seen: a shrunken
+        # world must not reject the final snapshot of a rank that was valid
+        # in the epoch the snapshot describes.
+        if not 0 <= rank < max(self.world_size, self.base_world):
             with self._lock:
                 self.events.append({
                     "ts": round(time.time(), 6), "kind": "snapshot_rejected",
@@ -469,12 +828,21 @@ class Tracker:
         return {
             "schema": TELEMETRY_SCHEMA,
             "world_size": self.world_size,
+            "base_world": self.base_world,
             "started_at": round(self._started_at, 6),
             "finished_at": round(time.time(), 6),
             "n_waves": len(waves),
             "n_recovery_waves": sum(1 for w in waves if w["epoch"] > 0),
             "n_lease_expired": sum(1 for e in events
                                    if e["kind"] == "lease_expired"),
+            "n_shrunk": sum(1 for e in events
+                            if e["kind"] == "world_shrunk"),
+            "n_grown": sum(1 for e in events
+                           if e["kind"] == "world_grown"),
+            "n_spares_promoted": sum(1 for e in events
+                                     if e["kind"] == "spare_promoted"),
+            "epochs": [{"epoch": we.epoch, "world": we.world_size}
+                       for we in self.elastic.history],
             "restarts": restarts,
             "clocks": clocks,
             "waves": waves,
@@ -504,62 +872,3 @@ class Tracker:
         except OSError:
             return None  # observability must not fail the job
 
-    def _assign_and_send(self, wave: list[_Pending], epoch: int) -> None:
-        # Stable re-admission > launcher numbering > host-grouped fill; see
-        # assign_ranks for the full policy and rationale.
-        self._ranks.update(
-            assign_ranks(
-                [(p.task_id, p.host) for p in wave],
-                self.world_size,
-                self._ranks,
-                host_order=self.host_order,
-            )
-        )
-        peers = {
-            self._ranks[p.task_id]: (p.host, p.listen_port) for p in wave
-        }
-        # Timeline entry per bootstrap wave.  epoch 0 is the initial wave;
-        # any later wave is a recovery wave: survivors re-check-in with
-        # CMD_RECOVER while the launcher's restarted workers arrive with a
-        # fresh CMD_START — those restarts are the per-task restart count.
-        with self._lock:
-            restarted = []
-            for p in wave:
-                if p.cmd == P.CMD_START:
-                    n_seen = self._n_starts.get(p.task_id, 0)
-                    self._n_starts[p.task_id] = n_seen + 1
-                    if n_seen > 0:
-                        restarted.append(p.task_id)
-            self.events.append({
-                "ts": round(time.time(), 6),
-                "kind": "wave",
-                "epoch": epoch,
-                "assignments": {p.task_id: self._ranks[p.task_id]
-                                for p in wave},
-                "recovering": sorted(p.task_id for p in wave
-                                     if p.cmd == P.CMD_RECOVER),
-                "restarted": sorted(restarted),
-            })
-        n = self.world_size
-        for p in wave:
-            rank = self._ranks[p.task_id]
-            parent, children = P.tree_topology(rank, n)
-            asg = P.Assignment(
-                rank=rank,
-                world_size=n,
-                parent=parent,
-                children=children,
-                ring_prev=(rank - 1) % n,
-                ring_next=(rank + 1) % n,
-                peers=peers,
-                epoch=epoch,
-            )
-            try:
-                p.conn.sendall(asg.encode())
-            except OSError:
-                pass  # worker died mid-bootstrap; next wave will handle it
-            finally:
-                try:
-                    p.conn.close()
-                except OSError:
-                    pass

@@ -30,7 +30,7 @@ def run(nworkers, args, max_restarts=0, timeout=120.0):
     rc = cluster.run([sys.executable, WORKER, "rabit_engine=robust",
                       "ndata=2000", *args], timeout=timeout)
     assert rc == 0
-    assert all(r == 0 for r in cluster.returncodes)
+    assert all(r == 0 for r in cluster.returncodes.values())
     return cluster
 
 
@@ -57,7 +57,7 @@ def test_resume_then_worker_death(tmp_path):
     run(4, ["niter=6", "stop_at=2", d])
     c2 = run(4, ["niter=6", "rabit_engine=mock", "mock=1,0,3,0", d],
              max_restarts=3)
-    assert c2.restarts[1] == 1
+    assert c2.restarts["1"] == 1
     assert any("all 6 iterations verified" in m for m in c2.messages)
 
 

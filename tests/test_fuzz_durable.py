@@ -124,7 +124,7 @@ def test_fuzzed_whole_job_preemption(seed: int, tmp_path):
     rc = c2.run(cmd, timeout=90.0)
     detail = (f"seed {seed}: {sc}; resume rc={rc} "
               f"returncodes={c2.returncodes} messages={c2.messages[-6:]}")
-    assert rc == 0 and all(r == 0 for r in c2.returncodes), detail
+    assert rc == 0 and all(r == 0 for r in c2.returncodes.values()), detail
     verified = sum(f"all {sc['niter']} iterations verified" in m
                    for m in c2.messages)
     assert verified == sc["world"], detail

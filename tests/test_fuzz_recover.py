@@ -149,7 +149,7 @@ def _run_schedule(seed: int, world: int, args: list[str]) -> None:
     assert rc == 0, (
         f"seed {seed} (RABIT_FUZZ_WORLD_MAX={WORLD_MAX}): "
         f"world={world} args={args!r} rc={rc}")
-    assert all(r == 0 for r in cluster.returncodes), (
+    assert all(r == 0 for r in cluster.returncodes.values()), (
         f"seed {seed} (RABIT_FUZZ_WORLD_MAX={WORLD_MAX}): "
         f"world={world} args={args!r} "
         f"returncodes={cluster.returncodes}")

@@ -20,7 +20,7 @@ def run_cluster(nworkers, worker_args, max_restarts=10, timeout=300.0):
     cmd = [sys.executable, WORKER, "rabit_engine=mock", *worker_args]
     cluster = LocalCluster(nworkers, max_restarts=max_restarts, quiet=True)
     assert cluster.run(cmd, timeout=timeout) == 0
-    assert all(rc == 0 for rc in cluster.returncodes)
+    assert all(rc == 0 for rc in cluster.returncodes.values())
     return cluster
 
 

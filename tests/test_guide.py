@@ -58,7 +58,7 @@ def test_lazy_allreduce_py_mock_failure():
         timeout=90,
     )
     assert rc == 0
-    assert cluster.restarts[0] == 1
+    assert cluster.restarts["0"] == 1
 
 
 def test_hybrid_gbdt_py_solo():
@@ -83,7 +83,7 @@ def test_hybrid_gbdt_py_mock_failure():
         timeout=300,
     )
     assert rc == 0
-    assert cluster.restarts[1] == 1
+    assert cluster.restarts["1"] == 1
     reports = sorted(m for m in cluster.messages if "hybrid gbdt" in m)
     assert len(reports) == 2, cluster.messages
     acc = [m.split("train-acc ")[1] for m in reports]
@@ -135,7 +135,7 @@ def test_lazy_allreduce_cc_mock_failure(cpp_examples):
         timeout=90,
     )
     assert rc == 0
-    assert cluster.restarts[1] == 1
+    assert cluster.restarts["1"] == 1
 
 
 def test_durable_resume_py(tmp_path):

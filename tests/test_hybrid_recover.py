@@ -33,7 +33,7 @@ def run_cluster(nworkers, worker_args, out: Path, max_restarts=10,
            *worker_args]
     cluster = LocalCluster(nworkers, max_restarts=max_restarts, quiet=True)
     assert cluster.run(cmd, timeout=timeout, preempt=preempt) == 0
-    assert all(rc == 0 for rc in cluster.returncodes)
+    assert all(rc == 0 for rc in cluster.returncodes.values())
     if not expect_out:  # a stop_at= run exits before writing the forest
         return cluster, None
     return cluster, np.load(out.with_suffix(".npy"))

@@ -1,0 +1,96 @@
+"""Self-verifying ELASTIC workload (doc/elasticity.md).
+
+The process-level counterpart of the in-thread ElasticWorker harness
+tests use: launched by ``LocalCluster(..., spares=K)``, each process
+reads its identity from the DMLC_* environment (``RABIT_TPU_RABIT_SPARE``
+marks the hot spares the launcher adds) and runs the deterministic
+iterate-allreduce loop over one shared synthetic dataset, re-cut per
+epoch by the dense elastic partition.  The expected totals are known in
+closed form, so every completed worker verifies its final state
+bitwise — at ANY sequence of world sizes — and exits nonzero on a wrong
+bit.
+
+Worker args (k=v on the command line):
+    rows=N      total dataset rows, shared by all ranks (default 64)
+    bins=B      histogram bins (default 8)
+    niter=N     iterations (default 6)
+    sleep=S     seconds per iteration (default 0.05) — keeps the run long
+                enough for timed external preemptions to land mid-work
+    hb=S        heartbeat interval (default 0.2; leases expire at 2x)
+    die=TASK:V  task TASK dies silently before contributing to version V
+                (exit 0: a scheduled death must not be restarted — the
+                no-replacement-capacity shape shrink covers)
+    deadline=S  worker deadline (default 60)
+
+Exit codes: 0 = completed bitwise-correct, or parked-only spare, or a
+scheduled death; 1 = wrong bits or an unexpected error.
+"""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, __file__.rsplit("/tests/", 1)[0])
+
+from rabit_tpu.elastic.client import ElasticWorker  # noqa: E402
+from rabit_tpu.elastic.rebalance import shard_slice  # noqa: E402
+
+
+def getarg(name: str, default: str) -> str:
+    for a in sys.argv[1:]:
+        if a.startswith(name + "="):
+            default = a.split("=", 1)[1]
+    return default
+
+
+def main() -> int:
+    host = os.environ["DMLC_TRACKER_URI"]
+    port = int(os.environ["DMLC_TRACKER_PORT"])
+    task_id = os.environ["DMLC_TASK_ID"]
+    spare = os.environ.get("RABIT_TPU_RABIT_SPARE", "0") == "1"
+    rows = int(getarg("rows", "64"))
+    bins = int(getarg("bins", "8"))
+    niter = int(getarg("niter", "6"))
+    sleep = float(getarg("sleep", "0.05"))
+    hb = float(getarg("hb", "0.2"))
+    deadline = float(getarg("deadline", "60"))
+    die = getarg("die", "")
+    fail = None
+    if die:
+        die_task, die_version = die.split(":")
+        if die_task == task_id:
+            fail = ("die", int(die_version))
+
+    data = np.arange(rows, dtype=np.int64) % bins
+
+    def contribution(version: int, world: int, rank: int) -> np.ndarray:
+        time.sleep(sleep)
+        shard = data[shard_slice(rows, world, rank)]
+        return np.bincount(shard, minlength=bins).astype(np.int64) * version
+
+    worker = ElasticWorker((host, port), task_id, contribution, niter,
+                           spare=spare, heartbeat_sec=hb,
+                           deadline_sec=deadline, fail=fail)
+    res = worker.run()
+    if res.died and fail is not None:
+        return 0  # the scheduled death; the launcher must not restart it
+    if res.parked_only:
+        return 0  # a spare the job never needed
+    if not res.completed:
+        print(f"[elastic_worker {task_id}] failed: {res.error}",
+              file=sys.stderr, flush=True)
+        return 1
+    expected = sum(np.bincount(data, minlength=bins).astype(np.int64) * v
+                   for v in range(1, niter + 1))
+    if not np.array_equal(res.state, expected):
+        print(f"[elastic_worker {task_id}] WRONG BITS: state={res.state} "
+              f"expected={expected} worlds={res.worlds}",
+              file=sys.stderr, flush=True)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -11,6 +11,15 @@ replaying lost results, and serving the checkpoint.
 Prints one JSON line per world size:
   {"world": N, "clean_s": ..., "failure_s": ..., "recovery_overhead_s": ...}
 
+``--elastic`` switches to the elastic-membership mode (doc/elasticity.md):
+seeded promote/shrink/grow scenarios with in-process ``ElasticWorker``
+threads against an elastic tracker, reporting the spare-promotion-latency
+vs. shrink-wave-latency curve per world size — every number derived from
+structured tracker events (``spare_promoted`` / ``world_shrunk`` /
+``world_grown`` timestamps), no stdout scraping.  The driver embeds these
+lines under ``"elastic"`` in the bench record (bench.py), so the BENCH
+trajectory picks them up.
+
 ``--blob-mb B [B ...]`` switches to the checkpoint-serve-scaling mode
 (round-5 verdict #3): the worker carries a B-MiB content-verified blob in
 its global model, so the restarted rank's recovery streams a realistic
@@ -66,7 +75,7 @@ def run_once(world: int, extra: list[str], timeout: float | None = None,
         timeout = max(180.0, world * 12.0)
     rc = cluster.run(cmd, timeout=timeout)
     dt = time.perf_counter() - t0
-    if rc != 0 or any(r != 0 for r in cluster.returncodes):
+    if rc != 0 or any(r != 0 for r in cluster.returncodes.values()):
         raise RuntimeError(f"cluster failed: rc={rc} {cluster.returncodes}")
     # Structured events throughout (the stdout-scraping this tool used to
     # do is what rabit_tpu.profile's deprecated parsers served): the
@@ -212,6 +221,127 @@ def resume_sweep(blob_mbs: list[float], worlds: list[int]) -> None:
             }), flush=True)
 
 
+def _elastic_once(world: int, *, with_spare: bool, grow_back: bool,
+                  shrink_after_sec: float, niter: int = 6,
+                  iter_sleep: float = 0.05, kill_version: int = 2,
+                  deadline_sec: float = 45.0) -> dict:
+    """One elastic scenario (doc/elasticity.md): kill rank-1's worker at
+    ``kill_version``; with a spare parked the tracker must promote it
+    within one wave, without one the wave closes shrunk after
+    ``shrink_after_sec`` (and grows back when a late spare arrives, when
+    ``grow_back``).  Latencies are death -> the membership event's ``ts``,
+    both sides structured: the death instant is the dying worker thread's
+    return (an ElasticWorker with fail=("die", v) returns the moment it
+    dies), the membership instants are tracker-event timestamps."""
+    import threading
+
+    import numpy as np
+
+    from rabit_tpu.elastic.client import ElasticWorker
+    from rabit_tpu.elastic.rebalance import shard_slice
+    from rabit_tpu.tracker.tracker import Tracker
+
+    n_rows, n_bins = 8 * world, 8
+    data = np.arange(n_rows) % n_bins
+
+    def contribution(version, w, r):
+        time.sleep(iter_sleep)
+        rows = data[shard_slice(n_rows, w, r)]
+        return np.bincount(rows, minlength=n_bins).astype(np.int64) * version
+
+    tracker = Tracker(world, quiet=True, shrink_after_sec=shrink_after_sec,
+                      promote_after_sec=0.05).start()
+    addr = (tracker.host, tracker.port)
+    death_at = {}
+
+    def run_worker(w: ElasticWorker) -> None:
+        w.run()
+        if w.fail is not None:
+            death_at[w.task_id] = time.time()
+
+    workers = [
+        ElasticWorker(addr, str(i), contribution, niter,
+                      heartbeat_sec=0.1, wave_timeout=15.0,
+                      link_timeout=1.0, deadline_sec=deadline_sec,
+                      fail=("die", kill_version) if i == 1 else None)
+        for i in range(world)
+    ]
+    threads = [threading.Thread(target=run_worker, args=(w,), daemon=True)
+               for w in workers]
+    # A grow-back spare parks just after the shrink deadline would have
+    # passed — the next version boundary's CMD_EPOCH poll sees the pool
+    # and re-waves.
+    spare_delay = 0.0 if with_spare else (shrink_after_sec + 0.5
+                                          if grow_back else None)
+
+    def run_spare() -> None:
+        if spare_delay:
+            time.sleep(spare_delay)
+        run_worker(ElasticWorker(addr, "s0", contribution, niter, spare=True,
+                                 heartbeat_sec=0.1, wave_timeout=15.0,
+                                 link_timeout=1.0,
+                                 deadline_sec=deadline_sec))
+
+    spare_th = (threading.Thread(target=run_spare, daemon=True)
+                if spare_delay is not None else None)
+    try:
+        for th in threads:
+            th.start()
+        if spare_th is not None:
+            spare_th.start()
+        for th in threads:
+            th.join(timeout=deadline_sec + 5.0)
+            if th.is_alive():
+                raise TimeoutError(f"elastic bench world={world}: hang")
+    finally:
+        tracker.stop()
+        if spare_th is not None:
+            spare_th.join(timeout=10.0)
+    t_death = death_at.get("1")
+
+    def first_ts(kind):
+        return next((e["ts"] for e in tracker.events if e["kind"] == kind),
+                    None)
+
+    lat = lambda ts: (round(ts - t_death, 3)
+                      if ts is not None and t_death is not None else None)
+    return {
+        "promote_latency_s": lat(first_ts("spare_promoted")),
+        "shrink_latency_s": lat(first_ts("world_shrunk")),
+        "grow_latency_s": lat(first_ts("world_grown")),
+        "epochs": [{"epoch": we.epoch, "world": we.world_size}
+                   for we in tracker.elastic.history],
+    }
+
+
+def elastic_sweep(worlds: list[int],
+                  shrink_after_sec: float = 1.0) -> list[dict]:
+    """The promotion-vs-shrink curve: per world size, the same induced
+    death handled by a parked spare (promotion latency) and by the shrink
+    deadline + a late grow-back (shrink/grow latencies)."""
+    out = []
+    for world in worlds:
+        promote = _elastic_once(world, with_spare=True, grow_back=False,
+                                shrink_after_sec=shrink_after_sec)
+        # Slower, longer job so version boundaries remain AFTER the shrink
+        # for the grow-back wave to land on.
+        shrink = _elastic_once(world, with_spare=False, grow_back=True,
+                               shrink_after_sec=shrink_after_sec,
+                               niter=16, iter_sleep=0.15)
+        rec = {
+            "mode": "elastic", "world": world,
+            "shrink_after_sec": shrink_after_sec,
+            "promote_latency_s": promote["promote_latency_s"],
+            "promote_epochs": promote["epochs"],
+            "shrink_latency_s": shrink["shrink_latency_s"],
+            "grow_latency_s": shrink["grow_latency_s"],
+            "shrink_epochs": shrink["epochs"],
+        }
+        out.append(rec)
+        print(json.dumps(rec), flush=True)
+    return out
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("worlds", nargs="*", type=int, default=None)
@@ -221,8 +351,16 @@ def main() -> None:
                     help="durable whole-job resume timing mode (combine "
                          "with --blob-mb for payload scaling; blob 0 rows "
                          "come from plain --resume)")
+    ap.add_argument("--elastic", action="store_true",
+                    help="elastic-membership mode: spare-promotion vs "
+                         "shrink-wave latency per world size "
+                         "(doc/elasticity.md)")
+    ap.add_argument("--shrink-after", type=float, default=1.0,
+                    help="elastic mode's rabit_shrink_after_sec")
     args = ap.parse_args()
-    if args.resume:
+    if args.elastic:
+        elastic_sweep(args.worlds or [2, 4], args.shrink_after)
+    elif args.resume:
         resume_sweep(args.blob_mb or [0.0], args.worlds or [4])
     elif args.blob_mb:
         blob_sweep(args.blob_mb, args.worlds or [4])
