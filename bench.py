@@ -81,6 +81,12 @@ CODECS_RACED = ("identity", "bf16x2", "i8x2", "i8")
 # skips it.
 ELASTIC_BENCH = os.environ.get("RABIT_BENCH_ELASTIC", "1") != "0"
 ELASTIC_CHILD_TIMEOUT = 120.0
+# Schedule ablation (ISSUE 7): the planner's cost-model curve (pure, ~0s)
+# plus the live chaos slow_link repair A/B (tools/consensus_bench.py) in
+# a CPU child — the topology/degraded-link trajectory.  Deducted from the
+# TPU budget like the other riders; RABIT_BENCH_SCHED=0 skips it.
+SCHED_BENCH = os.environ.get("RABIT_BENCH_SCHED", "1") != "0"
+SCHED_CHILD_TIMEOUT = 120.0
 
 
 def log(msg):
@@ -375,6 +381,37 @@ def run_elastic_bench(timeout=ELASTIC_CHILD_TIMEOUT):
     return lines
 
 
+def run_sched_bench(timeout=SCHED_CHILD_TIMEOUT):
+    """Schedule ablation lines: the in-process planner cost-model curve
+    (pure, instant) plus the live slow_link repair A/B in a child
+    (threads + sleeps; a child so a wedged run cannot stall the driver).
+    Returns the JSON records, possibly without the e2e line on
+    timeout/failure."""
+    from tools.consensus_bench import schedule_ablation
+
+    lines = list(schedule_ablation())
+    cmd = [sys.executable,
+           os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "tools", "consensus_bench.py"),
+           "--slow-link-e2e"]
+    try:
+        r = subprocess.run(cmd, timeout=timeout, capture_output=True,
+                           text=True)
+        if r.returncode == 0:
+            for line in r.stdout.strip().splitlines():
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if isinstance(rec, dict) and rec.get("bench") == "slow_link_e2e":
+                    lines.append(rec)
+        else:
+            log(f"slow_link e2e child rc={r.returncode}")
+    except subprocess.TimeoutExpired:
+        log(f"slow_link e2e child timed out after {timeout:.0f}s")
+    return lines
+
+
 def probe_device(timeout=45.0) -> bool:
     """Fast TPU liveness check in a throwaway child: a wedged axon tunnel
     hangs at backend init (holding jax's lock forever), and burning the
@@ -531,6 +568,14 @@ def main():
                          min(tpu_budget, 300.0))
         log(f"elastic bench: {len(elastic_lines)} line(s); "
             f"TPU budget now {tpu_budget:.0f}s")
+    sched_lines = []
+    if SCHED_BENCH:
+        t_sc = time.time()
+        sched_lines = run_sched_bench()
+        tpu_budget = max(tpu_budget - (time.time() - t_sc),
+                         min(tpu_budget, 300.0))
+        log(f"schedule bench: {len(sched_lines)} line(s); "
+            f"TPU budget now {tpu_budget:.0f}s")
     res = try_tpu_within_budget(tpu_budget)
     n_rows = N_ROWS
     if not isinstance(res, dict):
@@ -558,6 +603,8 @@ def main():
             rec["codec_ablation"] = codec_lines
         if elastic_lines:
             rec["elastic"] = elastic_lines
+        if sched_lines:
+            rec["schedule_ablation"] = sched_lines
         print(json.dumps(rec), flush=True)
         return
     device_time = res["device_time"]
@@ -601,6 +648,8 @@ def main():
         rec["codec_ablation"] = codec_lines
     if elastic_lines:
         rec["elastic"] = elastic_lines
+    if sched_lines:
+        rec["schedule_ablation"] = sched_lines
     print(json.dumps(rec), flush=True)
 
 

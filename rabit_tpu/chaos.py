@@ -16,7 +16,15 @@ Slow the Entire AllReduce"):
 * **blackhole** — the connection stays open but nothing is ever forwarded
   (silent partition; the worst shape — only deadlines catch it);
 * **partition** — a switch: while on, new connections are refused and
-  every established one is severed.
+  every established one is severed;
+* **slow_link** — ASYMMETRIC per-link degradation (the arxiv 2606.01680
+  failure shape): only the client→upstream direction is delayed, and —
+  when the proxy fronts a worker's listen socket — only for the dialer
+  whose MAGIC_LINK hello carries a chosen source rank, so exactly ONE
+  direction of ONE ``(src, dst)`` peer link is slow.  This is the fault
+  the schedule planner's degraded-link repair routes around
+  (doc/scheduling.md); ``run_elastic_schedule(slow_link=...)`` wires the
+  proxy in front of the dst worker end-to-end.
 
 All randomness comes from one seeded ``random.Random`` so a failing fuzz
 schedule replays exactly.  The proxy is pure stdlib and threads; a
@@ -41,6 +49,7 @@ from __future__ import annotations
 
 import random
 import socket
+import struct
 import threading
 import time
 from dataclasses import dataclass, field
@@ -52,6 +61,10 @@ from rabit_tpu.tracker.tracker import Tracker
 
 #: recv chunk size of the pump loops; also the granularity of delay faults.
 _CHUNK = 4096
+
+#: link-hello field codecs (same layout as protocol.py's MAGIC_LINK frame)
+_U32 = struct.Struct("<I")
+_I32 = struct.Struct("<i")
 
 
 @dataclass
@@ -65,6 +78,13 @@ class FaultSpec:
     truncate_bytes: tuple[int, int] = (0, 64)
     p_blackhole: float = 0.0
     delay: tuple[float, float] = (0.0, 0.0)
+    #: asymmetric per-link slowness: ``(src_rank, delay_s)`` delays every
+    #: client->upstream chunk of connections whose MAGIC_LINK hello names
+    #: ``src_rank`` as the dialer (``src_rank=None`` delays the c2u
+    #: direction of EVERY connection — the one-way-congested tracker
+    #: path).  A proxy with slow_link set is a dedicated link proxy: the
+    #: sampled faults above do not apply to it.
+    slow_link: tuple[int | None, float] | None = None
 
     def clear(self) -> "FaultSpec":
         return FaultSpec()
@@ -78,6 +98,7 @@ class ChaosStats:
     blackholed: int = 0
     severed_by_partition: int = 0
     bytes_forwarded: int = 0
+    slowed: int = 0  # connections whose c2u direction got the slow_link
 
 
 @dataclass
@@ -194,7 +215,43 @@ class ChaosProxy:
             threading.Thread(target=self._serve_conn, args=(client,),
                              daemon=True, name="chaos-conn").start()
 
+    @staticmethod
+    def _peek_link_hello(client: socket.socket) -> tuple[bytes, int | None]:
+        """Read the 12-byte MAGIC_LINK hello (magic, rank, epoch) off a
+        fresh peer-link connection.  Returns (bytes read, dialer rank or
+        None); the bytes are forwarded upstream by the caller, so the
+        handshake is observed, never consumed."""
+        head = b""
+        try:
+            client.settimeout(5.0)
+            while len(head) < 12:
+                chunk = client.recv(12 - len(head))
+                if not chunk:
+                    break
+                head += chunk
+        except OSError:
+            return head, None
+        if len(head) < 8:
+            return head, None
+        magic = _U32.unpack_from(head, 0)[0]
+        if magic != P.MAGIC_LINK:
+            return head, None
+        return head, _I32.unpack_from(head, 4)[0]
+
     def _serve_conn(self, client: socket.socket) -> None:
+        spec = self.spec
+        head = b""
+        c2u_delay: tuple[float, float] | None = None
+        if spec.slow_link is not None:
+            # Dedicated link proxy: identify the dialer from the link
+            # hello, delay only the matching client->upstream direction.
+            src_rank, slow_s = spec.slow_link
+            dialer = None
+            if src_rank is not None:
+                head, dialer = self._peek_link_hello(client)
+            if src_rank is None or dialer == src_rank:
+                c2u_delay = (float(slow_s), float(slow_s))
+                self.stats.slowed += 1
         try:
             up = socket.create_connection(self.upstream, timeout=5.0)
         except OSError:
@@ -206,7 +263,22 @@ class ChaosProxy:
         conn = _Conn(client, up)
         with self._conns_lock:
             self._conns.append(conn)
-        spec = self.spec
+        if spec.slow_link is not None:
+            if head:
+                try:
+                    up.sendall(head)
+                    self.stats.bytes_forwarded += len(head)
+                except OSError:
+                    conn.sever()
+                    return
+            threading.Thread(
+                target=self._pump,
+                args=(conn, client, up, None, c2u_delay or (0.0, 0.0)),
+                daemon=True, name="chaos-pump-c2u").start()
+            threading.Thread(
+                target=self._pump, args=(conn, up, client, None, (0.0, 0.0)),
+                daemon=True, name="chaos-pump-u2c").start()
+            return
         with self._rng_lock:
             rng = self._roll()
             blackhole = rng.random() < spec.p_blackhole
@@ -297,7 +369,8 @@ def _random_spec(rng: random.Random) -> FaultSpec:
 
 def run_schedule(seed: int, world: int | None = None,
                  faulty_rounds: int = 2, deadline_sec: float = 20.0,
-                 quiet: bool = True) -> ScheduleResult:
+                 quiet: bool = True,
+                 slow_one_way: float | None = None) -> ScheduleResult:
     """One fuzzed bootstrap/recovery scenario (deterministic per seed).
 
     Thread-workers bootstrap through a freshly scripted :class:`ChaosProxy`
@@ -317,7 +390,12 @@ def run_schedule(seed: int, world: int | None = None,
     rng = random.Random(seed)
     world = world if world is not None else rng.choice([2, 3, 4])
     tracker = Tracker(world, quiet=True, conn_timeout_sec=1.0).start()
-    proxy = ChaosProxy((tracker.host, tracker.port), _random_spec(rng),
+    # slow_one_way swaps the sampled fault mix for the asymmetric shape:
+    # only the worker->tracker direction is delayed (hellos crawl,
+    # replies fly) until the heal round, when convergence is mandatory.
+    spec = (FaultSpec(slow_link=(None, float(slow_one_way)))
+            if slow_one_way is not None else _random_spec(rng))
+    proxy = ChaosProxy((tracker.host, tracker.port), spec,
                        seed=seed).start()
     t0 = time.monotonic()
     deadline = t0 + deadline_sec
@@ -417,11 +495,19 @@ class ElasticScheduleResult:
     epochs: list[dict]
     elapsed: float
     outcome: str  # "completed" | "failed"
+    schedule: str = "auto"    # the rabit_schedule value this run planned
+    n_repaired: int = 0       # schedule_repaired waves committed
+    dst_wait_s: float = 0.0   # slow_link runs: dst's cumulative link wait
+    dst_slow_reports: int = 0
 
 
 def run_elastic_schedule(seed: int, world: int | None = None,
                          deadline_sec: float = 30.0,
-                         quiet: bool = True) -> ElasticScheduleResult:
+                         quiet: bool = True,
+                         schedule: str | None = None,
+                         slow_link: tuple[int, int, float] | None = None,
+                         repair: bool = True,
+                         niter: int | None = None) -> ElasticScheduleResult:
     """One fuzzed shrink/grow scenario (deterministic per seed).
 
     A seeded mix of elastic failure shapes against a real elastic tracker:
@@ -446,6 +532,17 @@ def run_elastic_schedule(seed: int, world: int | None = None,
     completion of all never-killed workers, bitwise-correct final states,
     dense distinct ranks in every committed wave, strictly increasing
     epochs.
+
+    ``schedule`` pins the tracker's ``rabit_schedule`` (None samples one
+    per seed, so the fuzz campaigns sweep all four values).  ``slow_link
+    = (src, dst, delay_s)`` interposes a :class:`ChaosProxy` in front of
+    worker ``dst``'s listen socket that delays only ``src``'s frames —
+    the asymmetric degraded-link shape; the dst worker self-reports
+    (``slow_report_share``) and, with ``repair`` on, the tracker's next
+    wave routes the ring around the link (``repair=False`` is the
+    unrepaired control arm the bench compares against).  slow_link runs
+    disable the sampled kills/spares so the two arms differ only in the
+    repair.
     """
     from rabit_tpu.elastic.client import ElasticWorker
     from rabit_tpu.elastic.rebalance import shard_slice
@@ -453,8 +550,13 @@ def run_elastic_schedule(seed: int, world: int | None = None,
     rng = random.Random(seed)
     world = world if world is not None else rng.choice([2, 3, 4])
     n_spares = rng.choice([0, 1, 2])
-    niter = rng.choice([3, 4, 5])
+    drawn_niter = rng.choice([3, 4, 5])
+    niter = int(niter) if niter is not None else drawn_niter
     iter_sleep = rng.choice([0.05, 0.1])
+    if schedule is None:
+        schedule = rng.choice(["auto", "tree", "ring", "swing"])
+    if slow_link is not None:
+        n_spares = 0  # a clean A/B: no confounding resize traffic
     n_rows, n_bins = 8 * world, 8
     data = np.array([rng.randrange(n_bins) for _ in range(n_rows)])
 
@@ -468,7 +570,9 @@ def run_elastic_schedule(seed: int, world: int | None = None,
 
     n_kills = rng.randint(0, min(world - 1, 2))
     victims = rng.sample([str(i) for i in range(1, world)], n_kills)
-    kill_at = {t: rng.randint(2, niter) for t in victims}
+    kill_at = {t: rng.randint(2, max(niter, 2)) for t in victims}
+    if slow_link is not None:
+        kill_at = {}
     spare_specs = []
     for i in range(n_spares):
         roll = rng.random()
@@ -482,7 +586,8 @@ def run_elastic_schedule(seed: int, world: int | None = None,
     # the wave without it — splitting the job (doc/elasticity.md, "Choosing
     # the knobs").
     tracker = Tracker(world, quiet=quiet, conn_timeout_sec=1.0,
-                      shrink_after_sec=1.5, promote_after_sec=0.1).start()
+                      shrink_after_sec=1.5, promote_after_sec=0.1,
+                      schedule=schedule, sched_repair=repair).start()
     addr = (tracker.host, tracker.port)
     t0 = time.monotonic()
     results: dict[str, object] = {}
@@ -494,15 +599,38 @@ def run_elastic_schedule(seed: int, world: int | None = None,
             results[w.task_id] = res
 
     threads = []
+    workers: list["ElasticWorker"] = []
     for i in range(world):
         tid = str(i)
         fail = ("die", kill_at[tid]) if tid in kill_at else None
+        # slow_link runs need a longer link patience: the degraded hop
+        # legitimately stalls frames without the peer being dead.
+        link_to = 1.0 if slow_link is None else max(1.0, 4 * slow_link[2])
         w = ElasticWorker(addr, tid, contribution, niter,
                           heartbeat_sec=0.15, rpc_timeout=2.0,
-                          wave_timeout=10.0, link_timeout=1.0,
+                          wave_timeout=10.0, link_timeout=link_to,
                           deadline_sec=deadline_sec, fail=fail)
+        workers.append(w)
         threads.append(threading.Thread(target=run_worker, args=(w,),
                                         daemon=True))
+    link_proxy: ChaosProxy | None = None
+    if slow_link is not None:
+        src, dst, slow_s = slow_link
+        if not (0 <= src < world and 0 <= dst < world and src != dst):
+            raise ValueError(f"bad slow_link {slow_link!r} for world {world}")
+        # Interpose the link proxy in front of dst's listen socket: every
+        # inbound peer dial crosses it, but only src's frames are slowed
+        # (the proxy reads the MAGIC_LINK hello to tell dialers apart).
+        if src > dst:
+            # peer links are dialed by the LOWER rank; only in-dials
+            # cross a listen-side proxy, so the slowable direction is
+            # src < dst (the dialer's send path)
+            raise ValueError(f"slow_link wants src < dst, got {slow_link!r}")
+        link_proxy = ChaosProxy(
+            ("127.0.0.1", workers[dst].listen_port),
+            FaultSpec(slow_link=(src, float(slow_s))), seed=seed).start()
+        workers[dst].advertise_port = link_proxy.port
+        workers[dst].slow_report_share = 0.2
 
     spare_workers: list["ElasticWorker"] = []
 
@@ -539,6 +667,8 @@ def run_elastic_schedule(seed: int, world: int | None = None,
         # deadline.  A promoted spare finished with the group (collectives
         # are lockstep), so the short join below is enough.
         tracker.stop()
+        if link_proxy is not None:
+            link_proxy.stop()
         # A promoted spare mid-recovery would otherwise spin its bounded
         # re-check-in loop against the stopped tracker until its own
         # deadline — stop() flips it to a fast, clean exit.
@@ -588,6 +718,7 @@ def run_elastic_schedule(seed: int, world: int | None = None,
                 f"seed={seed}: wave epoch {e['epoch']} ranks {ranks} not "
                 f"dense for world {e['world']}")
     worlds_seen = sorted({e["world"] for e in waves})
+    dst_res = results.get(str(slow_link[1])) if slow_link is not None else None
     return ElasticScheduleResult(
         seed=seed, world=world, n_spares=n_spares, niter=niter,
         n_completed=len(completed), n_died=len(died),
@@ -596,4 +727,9 @@ def run_elastic_schedule(seed: int, world: int | None = None,
                 for we in tracker.elastic.history],
         elapsed=time.monotonic() - t0,
         outcome="completed",
+        schedule=schedule,
+        n_repaired=sum(1 for e in tracker.events
+                       if e["kind"] == "schedule_repaired"),
+        dst_wait_s=getattr(dst_res, "wait_prev_s", 0.0),
+        dst_slow_reports=getattr(dst_res, "slow_reports", 0),
     )

@@ -127,6 +127,17 @@ DEFAULTS: dict[str, str] = {
     "rabit_shrink_after_sec": "0",
     "rabit_min_world": "1",
     "rabit_spare_promote_sec": "0.25",
+    # Collective schedules (rabit_tpu/sched, doc/scheduling.md).
+    # rabit_schedule picks the per-epoch ring layout the tracker plans
+    # (auto|tree|ring|swing); rabit_sched_mesh pins the mesh-model dims
+    # ("RxC[:nowrap]", empty = near-square auto); rabit_sched_repair
+    # lets degraded-link reports trigger a repair replan at the next
+    # epoch boundary; rabit_sched_wait_share is the executor's
+    # wait-share threshold for indicting its incoming link.
+    "rabit_schedule": "auto",
+    "rabit_sched_mesh": "",
+    "rabit_sched_repair": "1",
+    "rabit_sched_wait_share": "0.25",
     # Cross-rank tracing (rabit_tpu/obs/trace.py, tools/trace_tool.py).
     # rabit_trace_exit=1: dump the flight ring as flight-*-exit.jsonl at
     # finalize, so CLEAN runs leave the per-rank evidence the job-wide

@@ -10,6 +10,18 @@ contributions and folds them in rank order (rank 0 first), so exact
 dtypes (integer histograms — the GBDT workload's shape) reproduce the
 same bits no matter how the world resized along the way.
 
+The worker executes whatever ring the tracker PLANNED
+(doc/scheduling.md): the Assignment's trailing schedule section carries
+a ring ORDER (``rabit_tpu.sched`` — identity for tree/ring, a
+mesh-serpentine Swing layout, or a repaired ring routed around a
+degraded link), links go to the planned neighbors, and allgather blocks
+are attributed by ring position — the FOLD stays rank-order, so every
+schedule reproduces the same bits.  The executor also measures how long
+it waits on its incoming link and, past ``slow_report_share`` of the
+epoch's wall time, reports the link as degraded (a ``slow_link`` print
+the tracker converts to a ``link_degraded`` event) — the live telemetry
+the next wave's repair plan consumes.
+
 Failure shape: any link error mid-collective abandons the epoch — links
 close, the worker re-checks-in with ``CMD_RECOVER``, and the next wave
 (same size after a spare promotion, smaller after a shrink, larger after
@@ -65,6 +77,11 @@ class ElasticResult:
     epochs: list[int] = field(default_factory=list)
     worlds: list[int] = field(default_factory=list)
     error: str = ""
+    #: cumulative seconds spent waiting on the incoming ring link across
+    #: all epochs — the degraded-link signature the benches compare
+    wait_prev_s: float = 0.0
+    #: slow_link reports this worker sent (at most one per epoch)
+    slow_reports: int = 0
 
 
 class ElasticWorker:
@@ -95,6 +112,8 @@ class ElasticWorker:
         link_timeout: float = 10.0,
         deadline_sec: float = 60.0,
         fail: tuple | None = None,
+        advertise_port: int | None = None,
+        slow_report_share: float = 0.0,
     ):
         self.tracker = (tracker[0], int(tracker[1]))
         self.task_id = task_id
@@ -116,6 +135,24 @@ class ElasticWorker:
         self._links: dict[int, socket.socket] = {}
         self._hb: Heartbeat | None = None
         self._rank = -1
+        # The port peers are told to dial — normally the listen port, but
+        # a chaos harness interposing a per-link proxy advertises the
+        # proxy's port instead (rabit_tpu.chaos slow_link).
+        self.advertise_port = advertise_port
+        # Degraded-link self-reporting (doc/scheduling.md): past this
+        # share of the epoch's wall time spent waiting on the incoming
+        # ring link, report it once per epoch.  0 disables.
+        self.slow_report_share = float(slow_report_share)
+        # planned-ring execution state, reset per assignment
+        self._order: list[int] = []
+        self._pos = 0
+        self._ring_prev = -1
+        self._ring_next = -1
+        self._wait_total_s = 0.0   # across all epochs (ElasticResult)
+        self._epoch_wait_s = 0.0
+        self._epoch_started = 0.0
+        self._epoch_reported = False
+        self._n_slow_reports = 0
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -146,7 +183,8 @@ class ElasticWorker:
                 sock = socket.create_connection(self.tracker,
                                                 timeout=self.rpc_timeout)
                 P.send_hello(sock, cmd, self.task_id, prev_rank=prev_rank,
-                             listen_port=self.listen_port)
+                             listen_port=self.advertise_port
+                             or self.listen_port)
                 asg = self._await_assignment(sock)
                 if asg is None:  # parked: wait for promotion, same socket
                     asg = self._await_assignment(sock, parked=True)
@@ -203,7 +241,8 @@ class ElasticWorker:
                                         timeout=self.rpc_timeout)
         try:
             P.send_hello(sock, P.CMD_SPARE, self.task_id,
-                         listen_port=self.listen_port)
+                         listen_port=self.advertise_port
+                         or self.listen_port)
             sock.settimeout(self.wave_timeout)
             version, blob = P.recv_blob_frame(sock)
             self._note_blob(version, blob)
@@ -228,6 +267,35 @@ class ElasticWorker:
                 sock.close()
             except OSError:
                 pass
+
+    def _maybe_report_slow(self, asg: P.Assignment) -> None:
+        """Degraded-link self-report (doc/scheduling.md, "Repair
+        policy"): when waiting on the incoming ring link has consumed
+        more than ``slow_report_share`` of this epoch's wall time, print
+        a ``slow_link`` line the tracker ingests as a ``link_degraded``
+        event.  At most one report per epoch; a delayed frame cascades
+        downstream, but the slow link's DST accumulates by far the most
+        wait, so self-attribution of the incoming link is correct."""
+        if (self.slow_report_share <= 0 or self._epoch_reported
+                or asg.world_size <= 1):
+            return
+        elapsed = time.monotonic() - self._epoch_started
+        if elapsed < 0.2:  # too little evidence to indict a link
+            return
+        share = self._epoch_wait_s / elapsed
+        if share < self.slow_report_share:
+            return
+        self._epoch_reported = True
+        self._n_slow_reports += 1
+        line = (f"[{asg.rank}] slow_link src={self._ring_prev} "
+                f"dst={asg.rank} wait={self._epoch_wait_s:.3f} "
+                f"share={share:.3f}")
+        try:
+            P.tracker_rpc(self.tracker[0], self.tracker[1], P.CMD_PRINT,
+                          self.task_id, prev_rank=asg.rank, message=line,
+                          timeout=self.rpc_timeout, retries=1)
+        except (P.TrackerUnreachable, ValueError):
+            pass  # reporting must never fail the job
 
     def _query_epoch(self) -> dict | None:
         try:
@@ -271,16 +339,36 @@ class ElasticWorker:
 
     # -- peer links ----------------------------------------------------------
 
+    def _adopt_schedule(self, asg: P.Assignment) -> None:
+        """Adopt the assignment's planned ring (doc/scheduling.md): a
+        valid trailing ring_order permutation wins, anything else (older
+        tracker, empty frame) falls back to the legacy identity ring.
+        Resets the epoch's wait accounting."""
+        world = asg.world_size
+        if (len(asg.ring_order) == world
+                and sorted(asg.ring_order) == list(range(world))):
+            self._order = list(asg.ring_order)
+        else:
+            self._order = list(range(world))
+        self._pos = self._order.index(asg.rank)
+        self._ring_prev = self._order[(self._pos - 1) % world]
+        self._ring_next = self._order[(self._pos + 1) % world]
+        self._epoch_wait_s = 0.0
+        self._epoch_started = time.monotonic()
+        self._epoch_reported = False
+
     def _build_links(self, asg: P.Assignment) -> None:
         """Establish the epoch's ring links: lower rank dials, higher rank
         accepts; the MAGIC_LINK handshake carries (rank, epoch) so stale
         dialers from a previous epoch are dropped (the native engine's
-        exact contract, comm.cc BuildLinks)."""
+        exact contract, comm.cc BuildLinks).  Neighbors come from the
+        PLANNED ring order, not the assignment's legacy prefix."""
         self._close_links()
+        self._adopt_schedule(asg)
         world = asg.world_size
         if world <= 1:
             return
-        neighbors = {asg.ring_prev, asg.ring_next} - {asg.rank}
+        neighbors = {self._ring_prev, self._ring_next} - {asg.rank}
         expect_accept = {p for p in neighbors if p < asg.rank}
         deadline = min(time.monotonic() + self.link_timeout, self.deadline)
         for peer in sorted(p for p in neighbors if p > asg.rank):
@@ -357,13 +445,18 @@ class ElasticWorker:
         blocks: dict[int, bytes] = {asg.rank: payload}
         if world == 1:
             return [payload]
-        nxt = self._links[asg.ring_next]
-        prv = self._links[asg.ring_prev]
+        nxt = self._links[self._ring_next]
+        prv = self._links[self._ring_prev]
         outgoing = payload
         for step in range(world - 1):
             self._send_frame(nxt, outgoing)
+            t0 = time.monotonic()
             incoming = self._recv_frame(prv)
-            blocks[(asg.rank - 1 - step) % world] = incoming
+            wait = time.monotonic() - t0
+            self._epoch_wait_s += wait
+            self._wait_total_s += wait
+            # the block s steps behind THIS POSITION in the planned ring
+            blocks[self._order[(self._pos - 1 - step) % world]] = incoming
             outgoing = incoming
         return [blocks[r] for r in range(world)]
 
@@ -375,14 +468,14 @@ class ElasticWorker:
         if world == 1:
             assert payload is not None
             return payload
-        dist = (asg.rank - root) % world
+        dist = (self._pos - self._order.index(root)) % world
         if dist == 0:
             assert payload is not None
-            self._send_frame(self._links[asg.ring_next], payload)
+            self._send_frame(self._links[self._ring_next], payload)
             return payload
-        payload = self._recv_frame(self._links[asg.ring_prev])
+        payload = self._recv_frame(self._links[self._ring_prev])
         if dist < world - 1:
-            self._send_frame(self._links[asg.ring_next], payload)
+            self._send_frame(self._links[self._ring_next], payload)
         return payload
 
     def _allreduce_sum(self, asg: P.Assignment,
@@ -459,6 +552,8 @@ class ElasticWorker:
             res.error = repr(exc)
             return res
         finally:
+            res.wait_prev_s = round(self._wait_total_s, 6)
+            res.slow_reports = self._n_slow_reports
             self._stop_heartbeat()
             self._close_links()
             try:
@@ -514,6 +609,7 @@ class ElasticWorker:
                     if asg.rank == 0:
                         self._ship_blob()
                     if self._version < self.niter:
+                        self._maybe_report_slow(asg)
                         info = self._query_epoch()
                         if info is not None and info.get("rewave"):
                             raise Rewave()

@@ -93,6 +93,13 @@ KINDS: dict[str, str] = {
     "bootstrap_blob": "tracker cached a spare bootstrap blob: version, nbytes",
     "epoch_changed": "worker adopted a new world epoch: epoch, world",
     "shard_rebalanced": "shard-rebalance callbacks ran for a resize",
+    # collective schedules (rabit_tpu/sched, doc/scheduling.md)
+    "schedule_planned": "tracker planned a wave's schedule: epoch, algo, "
+                        "ring_order, n_avoided",
+    "schedule_repaired": "plan rewritten around degraded links: epoch, "
+                         "avoided, residual",
+    "link_degraded": "worker slow_link report (from prints): src, dst, "
+                     "wait, share",
 }
 
 
@@ -245,6 +252,10 @@ def event_from_stats_line(line: str, ts: float | None = None) -> Event | None:
         kind = "worker_recovered"
     elif "resumed from disk" in line:
         kind = "disk_resume"
+    elif "slow_link " in line:
+        # an executor indicting its incoming ring link (rabit_tpu.sched
+        # repair policy): src=/dst= ranks, wait=/share= evidence
+        kind = "link_degraded"
     else:
         return None
     fields: dict = {"rank": _line_rank(line)}

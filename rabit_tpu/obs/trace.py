@@ -315,6 +315,7 @@ _TRACKER_INSTANTS = {
     "disk_resume", "metrics_snapshot",
     "spare_parked", "spare_dropped", "spare_promoted",
     "world_shrunk", "world_grown", "bootstrap_blob",
+    "schedule_planned", "schedule_repaired", "link_degraded",
 }
 
 

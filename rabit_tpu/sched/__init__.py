@@ -1,0 +1,64 @@
+"""rabit_tpu.sched — topology-aware collective schedule planning
+(ISSUE 7 tentpole; doc/scheduling.md).
+
+Three pieces, all pure:
+
+* **mesh** — the interconnect model: ranks row-major on a grid/torus,
+  link cost = hop distance (``rabit_sched_mesh`` or near-square auto
+  dims);
+* **planner** — ``plan(world, algo, mesh, avoid)``: tree/ring (the
+  reference's fixed layout), ``swing`` short-cutting rings laid as
+  boustrophedon Hamiltonian cycles over the mesh, and a deterministic
+  repair pass that rewrites any ring around flagged degraded links.
+  Plans are ring ORDERS — the fold stays rank-order, so every schedule
+  is bitwise identical;
+* **repair** — telemetry consumers turning ``link_degraded`` events and
+  straggler analytics into the planner's avoid set, with task-id keyed
+  persistence across elastic epochs.
+
+The tracker plans once per wave and ships the plan in the Assignment
+TRAILING the rank_map (the native C++ client reads up to the epoch and
+never sees it); elastic workers execute whatever ring order they are
+handed.  Replanning rides the elastic rewave path, so schedule repair
+and shrink/grow share one epoch boundary.
+"""
+
+from rabit_tpu.sched.mesh import (  # noqa: F401 (re-exports)
+    MeshModel,
+    auto_dims,
+    mesh_for_world,
+    parse_mesh_spec,
+)
+from rabit_tpu.sched.planner import (  # noqa: F401 (re-exports)
+    ALGOS,
+    Plan,
+    plan,
+    repair_ring,
+    ring_cost,
+    serpentine_order,
+    tree_cost,
+)
+from rabit_tpu.sched.repair import (  # noqa: F401 (re-exports)
+    flags_to_tasks,
+    links_from_events,
+    links_from_stragglers,
+    tasks_to_flags,
+)
+
+
+def resolve(cfg) -> dict:
+    """Resolve the schedule config keys (doc/parameters.md, "Collective
+    schedules") into the tracker/launcher-facing knobs: the algorithm
+    name, the mesh spec, whether degraded-link repair replans, and the
+    executor's slow-link report threshold."""
+    algo = (cfg.get("rabit_schedule", "auto") or "auto").strip().lower()
+    if algo not in ALGOS:
+        raise ValueError(
+            f"rabit_schedule={algo!r} is not one of {'|'.join(ALGOS)}")
+    return {
+        "schedule": algo,
+        "mesh": (cfg.get("rabit_sched_mesh", "") or "").strip(),
+        "repair": cfg.get_bool("rabit_sched_repair", True),
+        "wait_share": float(
+            cfg.get("rabit_sched_wait_share", "0.25") or "0.25"),
+    }

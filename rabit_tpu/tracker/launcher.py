@@ -73,6 +73,8 @@ class LocalCluster:
         extra_env: dict[str, str] | None = None,
         spares: int = 0,
         shrink_after_sec: float = 0.0,
+        schedule: str = "auto",
+        sched_mesh: str = "",
     ):
         self.num_workers = num_workers
         self.max_restarts = max_restarts
@@ -80,6 +82,8 @@ class LocalCluster:
         self.extra_env = extra_env or {}
         self.num_spares = int(spares)
         self.shrink_after_sec = float(shrink_after_sec)
+        self.schedule = schedule
+        self.sched_mesh = sched_mesh
         #: per-task restart / last-returncode bookkeeping, keyed by TASK ID
         #: (workers "0".."N-1", spares "s0".."sK-1") — dicts, not spawn-
         #: order lists, so elastic membership cannot index out of range.
@@ -171,7 +175,9 @@ class LocalCluster:
         ordinary recoverable death."""
         tracker = Tracker(self.num_workers, quiet=self.quiet,
                           on_suspect=self._on_suspect,
-                          shrink_after_sec=self.shrink_after_sec).start()
+                          shrink_after_sec=self.shrink_after_sec,
+                          schedule=self.schedule,
+                          sched_mesh=self.sched_mesh).start()
         self.messages = tracker.messages
         self.events = tracker.events
         primaries = [str(i) for i in range(self.num_workers)]
@@ -341,6 +347,17 @@ def main(argv: list[str] | None = None) -> int:
              "hole within SEC seconds (0 = legacy block-until-full)",
     )
     ap.add_argument(
+        "--schedule", default="auto", choices=("auto", "tree", "ring",
+                                               "swing"),
+        help="collective schedule the tracker plans per epoch "
+             "(rabit_schedule; doc/scheduling.md)",
+    )
+    ap.add_argument(
+        "--sched-mesh", default="", metavar="RxC[:nowrap]",
+        help="mesh-model dims for schedule planning (rabit_sched_mesh; "
+             "empty = near-square auto dims)",
+    )
+    ap.add_argument(
         "--preempt", action="append", default=[], metavar="DELAY:RANK",
         help="SIGKILL worker RANK DELAY seconds after launch, wherever it "
              "happens to be (repeatable; induced-preemption testing)",
@@ -377,7 +394,9 @@ def main(argv: list[str] | None = None) -> int:
     wedge = parse_schedule(args.wedge, "--wedge")
     cluster = LocalCluster(args.num_workers, args.max_restarts,
                            quiet=args.quiet, spares=args.spares,
-                           shrink_after_sec=args.shrink_after)
+                           shrink_after_sec=args.shrink_after,
+                           schedule=args.schedule,
+                           sched_mesh=args.sched_mesh)
     return cluster.run(cmd, timeout=args.timeout, preempt=preempt, wedge=wedge)
 
 

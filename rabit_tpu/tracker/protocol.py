@@ -52,6 +52,15 @@ workers is complete):
                      to the epoch and closes; the trailing map bytes are
                      discarded with the connection, so both clients stay
                      compatible with one tracker encoding.
+    str algo, u32 nring, i32 ring_order... — the epoch's planned collective
+                     schedule (rabit_tpu.sched; put_sched_frame /
+                     read_sched_frame).  Trails the rank_map for the same
+                     reason the map trails the epoch: the native client's
+                     prefix read never sees it.  The PREFIX keeps the
+                     legacy tree+ring (heap tree, identity ring) so the
+                     native data plane is byte-for-byte untouched;
+                     schedule-aware executors (rabit_tpu.elastic.client)
+                     adopt the trailing ring order instead.
 
 tracker -> worker (spare reply, immediate): u32 MAGIC_BLOB, u32 version,
     u32 nbytes, bytes — the cached compressed bootstrap blob (version 0 /
@@ -163,6 +172,12 @@ class Assignment:
     # the epoch on the wire so the native client, which reads up to the
     # epoch and closes, never sees it.
     rank_map: dict[str, int] = field(default_factory=dict)
+    # The epoch's planned schedule (rabit_tpu.sched): the resolved
+    # algorithm name and the planned ring order (ring_order[i] = rank at
+    # ring position i; empty = legacy identity ring).  Trails the
+    # rank_map — native-invisible, executor-adopted.
+    algo: str = ""
+    ring_order: list[int] = field(default_factory=list)
 
     def encode(self) -> bytes:
         out = [
@@ -181,6 +196,7 @@ class Assignment:
         out.append(put_u32(len(self.rank_map)))
         for task_id, r in sorted(self.rank_map.items()):
             out += [put_str(task_id), put_i32(r)]
+        out.append(put_sched_frame(self.algo, self.ring_order))
         return b"".join(out)
 
     @classmethod
@@ -212,8 +228,9 @@ class Assignment:
         for _ in range(get_u32(sock)):
             task_id = get_str(sock)
             rank_map[task_id] = get_i32(sock)
+        algo, ring_order = read_sched_frame(sock)
         return cls(rank, world, parent, children, ring_prev, ring_next,
-                   peers, epoch, rank_map)
+                   peers, epoch, rank_map, algo, ring_order)
 
 
 def tree_topology(rank: int, world: int) -> tuple[int, list[int]]:
@@ -248,6 +265,23 @@ def put_blob_frame(version: int, blob: bytes) -> bytes:
     a MAGIC_BLOB header (version 0 / empty payload = nothing cached)."""
     return b"".join([put_u32(MAGIC_BLOB), put_u32(version),
                      put_u32(len(blob)), blob])
+
+
+def put_sched_frame(algo: str, ring_order: list[int]) -> bytes:
+    """The Assignment's trailing schedule section (rabit_tpu.sched): the
+    resolved algorithm name and the planned ring order.  An empty order
+    means "execute the legacy identity ring" — the pre-schedule wire
+    shape."""
+    out = [put_str(algo), put_u32(len(ring_order))]
+    out += [put_i32(r) for r in ring_order]
+    return b"".join(out)
+
+
+def read_sched_frame(sock) -> tuple[str, list[int]]:
+    """Read one trailing schedule section; returns (algo, ring_order)."""
+    algo = get_str(sock)
+    ring_order = [get_i32(sock) for _ in range(get_u32(sock))]
+    return algo, ring_order
 
 
 def recv_blob_frame(sock) -> tuple[int, bytes]:

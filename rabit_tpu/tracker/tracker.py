@@ -38,6 +38,17 @@ ranks reassign densely and the job keeps making progress — then grows
 back (``world_grown``) when spares return, at the next version boundary
 (workers poll ``CMD_EPOCH`` between checkpoints and re-enter a wave when
 the reply carries ``rewave``).
+
+Collective schedules (doc/scheduling.md): every wave is planned by
+``rabit_tpu.sched`` — ``rabit_schedule=auto|tree|ring|swing`` picks the
+ring layout over the mesh model, and worker ``slow_link`` reports
+(``link_degraded`` events) flag degraded links the next plan routes
+around.  The Assignment's PREFIX keeps the legacy tree+ring (the native
+client's fixed data plane); the planned ring order trails the rank_map
+for schedule-aware executors.  Repair replanning rides the same
+``rewave`` epoch boundary as grow-back, so a degraded link is healed by
+one ordinary recovery wave (``schedule_planned``/``schedule_repaired``
+events).
 """
 
 from __future__ import annotations
@@ -50,6 +61,7 @@ import time
 from dataclasses import dataclass
 from typing import Callable
 
+from rabit_tpu import sched
 from rabit_tpu.elastic.membership import CLOSE, MembershipManager
 from rabit_tpu.obs.events import event_from_stats_line
 from rabit_tpu.tracker import protocol as P
@@ -173,7 +185,11 @@ class Tracker:
                  on_suspect: Callable[[str], None] | None = None,
                  shrink_after_sec: float = 0.0,
                  min_world: int = 1,
-                 promote_after_sec: float = 0.25):
+                 promote_after_sec: float = 0.25,
+                 schedule: str = "auto",
+                 sched_mesh: str = "",
+                 sched_repair: bool = True,
+                 sched_wait_share: float = 0.25):
         #: CURRENT world size — mutable under elastic membership (shrink/
         #: grow); ``base_world`` is the launch size and grow-back target.
         self.world_size = world_size
@@ -222,6 +238,18 @@ class Tracker:
                     "topology='tpu' but TPU_WORKER_HOSTNAMES is not set"
                 )
         self.host_order = host_order
+        # Collective schedule planning (rabit_tpu.sched): the algorithm
+        # name, the mesh-model spec, and whether degraded-link reports
+        # trigger a repair replan.  Link flags persist as TASK pairs so
+        # a resize between flag and repair cannot mis-aim the avoid set.
+        if schedule not in sched.ALGOS:
+            raise ValueError(f"schedule={schedule!r} not in {sched.ALGOS}")
+        self.schedule = schedule
+        self.sched_mesh = sched_mesh
+        self.sched_repair = bool(sched_repair)
+        self.sched_wait_share = float(sched_wait_share)
+        self._link_flags: set[tuple[str, str]] = set()  # (src_task, dst_task)
+        self._repair_wanted = False
         self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._srv.bind((host, port))
@@ -345,10 +373,14 @@ class Tracker:
                 P.get_str(conn)
                 with self._lock:
                     self._reap_spares_locked()
+                    # rewave on grow-back AND on a pending schedule
+                    # repair: both resolve at the same version-boundary
+                    # wave (doc/scheduling.md, "Repair policy").
                     info = {"epoch": self.elastic.epoch,
                             "world": self.world_size,
-                            "rewave": self.elastic.grow_wanted(
-                                len(self._spares))}
+                            "rewave": (self.elastic.grow_wanted(
+                                len(self._spares))
+                                or self._repair_wanted)}
                 conn.sendall(P.put_u32(P.ACK) + P.put_str(json.dumps(info)))
             elif cmd == P.CMD_BLOB:
                 version = P.get_u32(conn)
@@ -376,6 +408,8 @@ class Tracker:
                         self.events.append(
                             {"ts": round(ev.ts, 6), "kind": ev.kind,
                              **ev.fields})
+                    if ev.kind == "link_degraded":
+                        self._flag_link(ev.fields)
                 if not self.quiet:
                     print(msg, end="" if msg.endswith("\n") else "\n", flush=True)
                 conn.sendall(P.put_u32(P.ACK))
@@ -482,6 +516,50 @@ class Tracker:
         if not self.quiet:
             print(f"[tracker] spare {task_id} parked "
                   f"(blob v{version}, pool {len(self._spares)})", flush=True)
+
+    # -- schedule planning -------------------------------------------------
+
+    def _flag_link(self, fields: dict) -> None:
+        """Ingest one ``link_degraded`` report: record the (src, dst)
+        link as a task-id pair and arm the repair rewave.  With
+        ``sched_repair`` off the event is telemetry only — the plan
+        never changes (the bench's unrepaired control arm)."""
+        if not self.sched_repair:
+            return
+        try:
+            src, dst = int(fields["src"]), int(fields["dst"])
+        except (KeyError, TypeError, ValueError):
+            return
+        with self._lock:
+            tasks = sched.flags_to_tasks([(src, dst)],
+                                         self.elastic.current.rank_map)
+            fresh = tasks - self._link_flags
+            if not fresh:
+                return
+            self._link_flags |= fresh
+            self._repair_wanted = True
+        if not self.quiet:
+            print(f"[tracker] link {src}->{dst} flagged degraded; repair "
+                  f"replan armed", flush=True)
+
+    def flag_link(self, src: int, dst: int) -> None:
+        """Operator/analytics hook: flag a degraded link directly (the
+        offline path — ``sched.links_from_stragglers`` output, an
+        external NCCL-style failure localizer...).  Same effect as a
+        worker ``slow_link`` report."""
+        self._flag_link({"src": src, "dst": dst})
+
+    def _plan_schedule(self, world: int,
+                       rank_map: dict[str, int]) -> "sched.Plan":
+        """Plan the closing wave's schedule: resolve flags into this
+        epoch's rank pairs and route around them.  Pure planning — the
+        caller emits the events and stamps the assignments."""
+        with self._lock:
+            avoid = sched.tasks_to_flags(self._link_flags, rank_map)
+            self._repair_wanted = False  # this plan consumes the flags
+        return sched.plan(world, self.schedule,
+                          mesh=sched.mesh_for_world(world, self.sched_mesh),
+                          avoid=avoid)
 
     # -- elastic wave machinery --------------------------------------------
 
@@ -665,6 +743,31 @@ class Tracker:
         world = plan["world"]
         peers = {plan["rank_map"][p.task_id]: (p.host, p.listen_port)
                  for p in plan["members"]}
+        # Plan the epoch's collective schedule (rabit_tpu.sched).  The
+        # Assignment PREFIX stays the legacy tree+ring — the native
+        # client's fixed data plane; the planned ring order trails the
+        # rank_map for schedule-aware executors.
+        splan = self._plan_schedule(world, plan["rank_map"])
+        ts = round(time.time(), 6)
+        with self._lock:
+            self.events.append({
+                "ts": ts, "kind": "schedule_planned",
+                "epoch": plan["epoch"], "algo": splan.algo, "world": world,
+                "ring_order": list(splan.ring_order),
+                "n_avoided": len(splan.avoided),
+            })
+            if splan.repaired or splan.residual:
+                self.events.append({
+                    "ts": ts, "kind": "schedule_repaired",
+                    "epoch": plan["epoch"], "algo": splan.algo,
+                    "avoided": [list(l) for l in splan.avoided],
+                    "residual": [list(l) for l in splan.residual],
+                })
+        if (splan.repaired or splan.residual) and not self.quiet:
+            print(f"[tracker] schedule repaired for epoch {plan['epoch']}: "
+                  f"routed around {list(splan.avoided)}"
+                  + (f", residual {list(splan.residual)}"
+                     if splan.residual else ""), flush=True)
         for p in plan["members"]:
             rank = plan["rank_map"][p.task_id]
             parent, children = P.tree_topology(rank, world)
@@ -678,6 +781,8 @@ class Tracker:
                 peers=peers,
                 epoch=plan["epoch"],
                 rank_map=plan["rank_map"],
+                algo=splan.algo,
+                ring_order=list(splan.ring_order),
             )
             try:
                 p.conn.sendall(asg.encode())
@@ -841,6 +946,9 @@ class Tracker:
                            if e["kind"] == "world_grown"),
             "n_spares_promoted": sum(1 for e in events
                                      if e["kind"] == "spare_promoted"),
+            "schedule": self.schedule,
+            "n_schedule_repaired": sum(1 for e in events
+                                       if e["kind"] == "schedule_repaired"),
             "epochs": [{"epoch": we.epoch, "world": we.world_size}
                        for we in self.elastic.history],
             "restarts": restarts,

@@ -1,0 +1,8 @@
+// tpulint fixture: a miniature RecvAssignment that violates the native
+// prefix contract (reads past the epoch into Python-owned trailing data).
+void Comm::RecvAssignment(TcpSocket* sock) {
+  rank_ = GetI32(sock);
+  world_ = static_cast<int>(GetU32(sock));
+  epoch_ = static_cast<int>(GetU32(sock));
+  nmap_ = GetU32(sock);  // SEEDED: wire-native-prefix
+}

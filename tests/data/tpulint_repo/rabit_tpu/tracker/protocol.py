@@ -10,3 +10,7 @@ _HDR = struct.Struct("<II")  # packed below, never unpacked
 
 def pack_hdr(a, b):
     return _HDR.pack(a, b)  # SEEDED: wire-struct-oneway
+
+
+def put_orphan_frame(version):  # SEEDED: wire-frame-oneway
+    return _HDR.pack(version, 0)  # encoder with no recv_/read_ decoder

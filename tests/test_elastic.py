@@ -268,6 +268,93 @@ def test_gbdt_elastic_shard_recut_covers_dataset():
         assert np.array_equal(np.concatenate([s[1] for s in xs]), y)
 
 
+def test_xla_rebuild_mesh_drops_compiled_state():
+    """ISSUE 7 satellite: the PR 6 resize seam, exercised directly.
+    rebuild_mesh must drop EVERY artifact pinned to the old process mesh
+    (the Mesh, the jitted reduce fns, the compressed-path pairs),
+    re-read the topology from jax, and record the epoch_changed event
+    with the ring-link delta."""
+    from rabit_tpu import obs
+    from rabit_tpu.config import Config
+    from rabit_tpu.engine.xla import XlaEngine
+
+    eng = XlaEngine(Config(["rabit_tracker_uri=NULL"]))
+    eng._rank, eng._world = 0, 3        # pretend a 3-process past life
+    eng._mesh = object()
+    eng._jits[2] = lambda x: x
+    eng._cjits[("k",)] = (None, None)
+    before = len(obs.get_recorder().snapshot())
+    eng.rebuild_mesh()
+    assert eng._mesh is None
+    assert eng._jits == {} and eng._cjits == {}
+    # re-read from the live (single-process CPU) jax runtime
+    assert eng.get_rank() == 0 and eng.get_world_size() == 1
+    events = obs.get_recorder().snapshot()[before:]
+    changed = [e for e in events if e.kind == "epoch_changed"]
+    assert changed and changed[-1].fields["world"] == 1
+    # 3 -> 1 ring: the delta names removed links
+    assert changed[-1].fields["links_removed"] > 0
+
+
+class _FakeNativeLib:
+    """Mocked ctypes bridge for NativeEngine seam tests: records the
+    call order and returns success (or a scripted failure)."""
+
+    def __init__(self, fail_finalize: bool = False):
+        self.calls: list[str] = []
+        self.fail_finalize = fail_finalize
+
+    def RabitInit(self, n, arr):
+        self.calls.append("init")
+        return 0
+
+    def RabitFinalize(self):
+        self.calls.append("finalize")
+        return 1 if self.fail_finalize else 0
+
+    def RabitGetRank(self):
+        return 0
+
+    def RabitGetWorldSize(self):
+        return 2
+
+    def TrtGetLastError(self):
+        return b"scripted failure"
+
+
+def _mock_native_engine(lib):
+    from rabit_tpu.config import Config
+    from rabit_tpu.engine.base import Engine
+    from rabit_tpu.engine.native import NativeEngine
+
+    eng = NativeEngine.__new__(NativeEngine)  # skip load_lib()
+    Engine.__init__(eng, Config(["rabit_tracker_uri=NULL"]))
+    eng._kind = "native"
+    eng._lib = lib
+    return eng
+
+
+def test_native_rebootstrap_is_finalize_then_init():
+    """ISSUE 7 satellite: NATIVE resizes only by full re-bootstrap
+    (doc/elasticity.md, "Known limitations") — rebootstrap must
+    finalize the old world and re-enter init, in that order."""
+    lib = _FakeNativeLib()
+    eng = _mock_native_engine(lib)
+    eng.rebootstrap()
+    assert lib.calls == ["finalize", "init"]
+    assert eng.get_world_size() == 2
+
+
+def test_native_rebootstrap_failed_finalize_does_not_reinit():
+    from rabit_tpu.engine.native import NativeError
+
+    lib = _FakeNativeLib(fail_finalize=True)
+    eng = _mock_native_engine(lib)
+    with pytest.raises(NativeError, match="finalize failed"):
+        eng.rebootstrap()
+    assert lib.calls == ["finalize"]  # init never reached
+
+
 def test_elastic_settings_resolve_config_keys():
     import rabit_tpu.elastic as elastic
     from rabit_tpu.config import Config

@@ -59,6 +59,8 @@ def seeded_line(relpath: str, rule: str) -> int:
     ("wire-cmd-mismatch", "rabit_tpu/tracker/protocol.py"),
     ("wire-cmd-unhandled", "rabit_tpu/tracker/protocol.py"),
     ("wire-struct-oneway", "rabit_tpu/tracker/protocol.py"),
+    ("wire-frame-oneway", "rabit_tpu/tracker/protocol.py"),
+    ("wire-native-prefix", "native/src/comm.cc"),
 ])
 def test_fixture_violation_flagged(rule, relpath):
     proc = run_tpulint("--root", str(FIXTURE))

@@ -8,9 +8,25 @@ only on divergence.  This tool measures tiny-payload robust allreduce
 latency with the fast path on (rabit_consensus_summary=1, default) and
 forced off (=0) at a given world size.
 
+PR 7 adds the schedule surface (doc/scheduling.md):
+
+* ``--smoke`` — tiny-world sanity: one in-thread elastic job per
+  ``rabit_schedule`` value (auto/tree/ring/swing); all four must
+  complete **bitwise identically** and match the closed form.  Tier-1
+  runs this via tests/test_sched.py;
+* ``--schedule-ablation`` — the planner's cost-model curve on a
+  simulated mesh (no cluster): fixed tree+ring vs planned ring vs Swing
+  serpentine ring, plus a degraded-link column (one ring link slowed
+  ``--slow-factor``x, unrepaired vs repaired plan).  The measured
+  world-512 depth-17 consensus baseline (RESULTS.md §3) is the anchor
+  these modeled curves sit on top of;
+* ``--slow-link-e2e`` — the live repair A/B: a chaos ``slow_link``
+  schedule run with repair off then on; the dst worker's cumulative
+  link wait must drop once the ring routes around the degraded link.
+
 Usage:  python tools/consensus_bench.py [--world 32] [--iters 200]
-Prints one JSON line per mode; run as __main__ only (spawns a local
-cluster).
+Prints one JSON line per mode; the default latency mode runs as
+__main__ only (spawns a local cluster).
 """
 
 from __future__ import annotations
@@ -86,11 +102,189 @@ def run_mode(world: int, iters: int, summary_on: bool) -> tuple[float, dict]:
         return float(out.read_text()), stats
 
 
+# -- schedule surface (rabit_tpu.sched; doc/scheduling.md) -------------------
+
+def run_smoke(world: int = 3, niter: int = 3) -> dict:
+    """One in-thread elastic job per ``rabit_schedule`` value; asserts
+    every mode completes with the SAME bits (and the closed form).  The
+    tier-1 schedule sanity gate (tests/test_sched.py)."""
+    import threading
+
+    import numpy as np
+
+    from rabit_tpu import sched
+    from rabit_tpu.config import Config
+    from rabit_tpu.elastic.client import ElasticWorker
+    from rabit_tpu.elastic.rebalance import shard_slice
+    from rabit_tpu.tracker.tracker import Tracker
+
+    n_rows, n_bins = 8 * world, 16
+    data = (np.arange(n_rows, dtype=np.int64) * 7) % n_bins
+
+    def contribution(version: int, w: int, r: int) -> "np.ndarray":
+        rows = data[shard_slice(n_rows, w, r)]
+        return np.bincount(rows, minlength=n_bins).astype(np.int64) * version
+
+    expected = sum(np.bincount(data, minlength=n_bins).astype(np.int64) * v
+                   for v in range(1, niter + 1))
+    out: dict = {"bench": "schedule_smoke", "world": world, "niter": niter,
+                 "modes": {}}
+    states: dict[str, "np.ndarray"] = {}
+    for algo in sched.ALGOS:
+        knobs = sched.resolve(Config([f"rabit_schedule={algo}"]))
+        tracker = Tracker(world, quiet=True, schedule=knobs["schedule"],
+                          sched_mesh=knobs["mesh"],
+                          sched_repair=knobs["repair"]).start()
+        results: dict[str, object] = {}
+        lock = threading.Lock()
+
+        def run_one(w: "ElasticWorker") -> None:
+            res = w.run()
+            with lock:
+                results[w.task_id] = res
+
+        workers = [ElasticWorker((tracker.host, tracker.port), str(i),
+                                 contribution, niter, wave_timeout=10.0,
+                                 link_timeout=5.0, deadline_sec=30.0)
+                   for i in range(world)]
+        threads = [threading.Thread(target=run_one, args=(w,), daemon=True)
+                   for w in workers]
+        try:
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join(timeout=40.0)
+                assert not th.is_alive(), f"{algo}: worker thread hung"
+        finally:
+            tracker.stop()
+        for tid, res in sorted(results.items()):
+            assert res.completed, f"{algo}: worker {tid} failed: {res.error}"
+            assert np.array_equal(res.state, expected), (
+                f"{algo}: worker {tid} bits diverge from closed form")
+        planned = [e for e in tracker.events
+                   if e["kind"] == "schedule_planned"]
+        assert planned, f"{algo}: no schedule_planned event"
+        states[algo] = results["0"].state
+        out["modes"][algo] = {
+            "resolved": planned[-1]["algo"],
+            "ring_order": planned[-1]["ring_order"],
+            "completed": len(results),
+        }
+    reference = states["tree"]
+    out["bitwise_identical"] = all(
+        np.array_equal(states[a], reference) for a in states)
+    assert out["bitwise_identical"], "schedules diverged bitwise"
+    return out
+
+
+def schedule_ablation(worlds=(64, 128, 256, 384, 512), mesh_spec: str = "",
+                      slow_factor: float = 8.0) -> list[dict]:
+    """The planner cost-model curve (pure — no cluster): per world, the
+    fixed tree+ring layout vs the planned identity ring vs the Swing
+    serpentine ring on the simulated mesh, in lockstep-round units
+    (``(W-1) * max_link_hops``; doc/scheduling.md, "Cost model").  The
+    degraded columns slow ONE ring link by ``slow_factor`` and compare
+    the unrepaired plan against the repaired one."""
+    from rabit_tpu import sched
+
+    lines = []
+    for world in worlds:
+        mesh = sched.mesh_for_world(world, mesh_spec)
+        ring = sched.ring_cost(sched.plan(world, "ring").ring_order, mesh)
+        swing_plan = sched.plan(world, "swing")
+        swing = sched.ring_cost(swing_plan.ring_order, mesh)
+        tree = sched.tree_cost(world, mesh)
+        # degrade the first planned ring link; the repaired plan must
+        # route around it and shed the slow factor from the bottleneck
+        bad = swing_plan.links()[0]
+        slow = {bad: slow_factor}
+        unrepaired = sched.ring_cost(swing_plan.ring_order, mesh, slow=slow)
+        repaired_plan = sched.plan(world, "swing", avoid={bad})
+        repaired = sched.ring_cost(repaired_plan.ring_order, mesh, slow=slow)
+        lines.append({
+            "bench": "schedule_ablation",
+            "world": world,
+            "mesh": f"{mesh.rows}x{mesh.cols}"
+                    + ("" if mesh.wrap else ":nowrap"),
+            "tree_depth": tree["depth"],
+            "tree_critical_path": tree["critical_path"],
+            "ring_round_cost": ring["round_cost"],
+            "swing_round_cost": swing["round_cost"],
+            "swing_vs_fixed_ring": round(
+                ring["round_cost"] / swing["round_cost"], 2)
+            if swing["round_cost"] else 1.0,
+            "degraded_link": list(bad),
+            "slow_factor": slow_factor,
+            "degraded_unrepaired_cost": unrepaired["round_cost"],
+            "degraded_repaired_cost": repaired["round_cost"],
+            "repair_gain": round(
+                unrepaired["round_cost"] / repaired["round_cost"], 2)
+            if repaired["round_cost"] else 1.0,
+            "repaired_avoided": [list(l) for l in repaired_plan.avoided],
+        })
+    return lines
+
+
+def slow_link_e2e(world: int = 3, delay: float = 0.12, niter: int = 8,
+                  seed: int = 5) -> dict:
+    """The live degraded-link A/B (chaos ``slow_link`` through real
+    elastic workers): identical schedule with repair off then on; the
+    dst worker's cumulative wait on the slow link must drop once the
+    repaired ring routes around it."""
+    from rabit_tpu.chaos import run_elastic_schedule
+
+    link = (1, 2, delay)
+    off = run_elastic_schedule(seed, world=world, schedule="ring",
+                               slow_link=link, repair=False, niter=niter,
+                               deadline_sec=60.0)
+    on = run_elastic_schedule(seed, world=world, schedule="ring",
+                              slow_link=link, repair=True, niter=niter,
+                              deadline_sec=60.0)
+    return {
+        "bench": "slow_link_e2e",
+        "world": world,
+        "slow_link": list(link),
+        "niter": niter,
+        "unrepaired_dst_wait_s": off.dst_wait_s,
+        "repaired_dst_wait_s": on.dst_wait_s,
+        "wait_drop": round(off.dst_wait_s / on.dst_wait_s, 2)
+        if on.dst_wait_s else float("inf"),
+        "n_repaired_waves": on.n_repaired,
+        "dst_reported": on.dst_slow_reports,
+        "routed_around": on.n_repaired >= 1
+        and on.dst_wait_s < off.dst_wait_s,
+    }
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--world", type=int, default=32)
     ap.add_argument("--iters", type=int, default=200)
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny-world schedule sanity: all rabit_schedule "
+                         "values must converge bitwise-identically")
+    ap.add_argument("--schedule-ablation", action="store_true",
+                    help="planner cost-model curve on a simulated mesh")
+    ap.add_argument("--slow-link-e2e", action="store_true",
+                    help="live chaos slow_link repair A/B")
+    ap.add_argument("--worlds", type=int, nargs="*",
+                    default=[64, 128, 256, 384, 512],
+                    help="worlds for --schedule-ablation")
+    ap.add_argument("--mesh", default="",
+                    help="mesh spec RxC[:nowrap] for --schedule-ablation")
+    ap.add_argument("--slow-factor", type=float, default=8.0)
     args = ap.parse_args()
+    if args.smoke:
+        print(json.dumps(run_smoke()), flush=True)
+        return
+    if args.schedule_ablation:
+        for line in schedule_ablation(tuple(args.worlds), args.mesh,
+                                      args.slow_factor):
+            print(json.dumps(line), flush=True)
+        return
+    if args.slow_link_e2e:
+        print(json.dumps(slow_link_e2e()), flush=True)
+        return
     results = {}
     for on in (True, False):
         per_op, stats = run_mode(args.world, args.iters, on)

@@ -83,9 +83,10 @@ def run(root: Path) -> list[Finding]:
     protocol_py = root / "rabit_tpu" / "tracker" / "protocol.py"
     tracker_py = root / "rabit_tpu" / "tracker" / "tracker.py"
     comm_h = root / "native" / "src" / "comm.h"
+    comm_cc = root / "native" / "src" / "comm.cc"
     struct_files = iter_python_files(root, ["rabit_tpu/**/*.py"])
     findings += wire.check_wire(protocol_py, tracker_py, comm_h,
-                                struct_files, root)
+                                struct_files, root, comm_cc=comm_cc)
 
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
     return findings

@@ -17,7 +17,18 @@ hang at bootstrap, not an error message.  Three invariants:
 * ``wire-struct-oneway`` — a ``struct`` format (``struct.Struct`` binding
   or direct ``struct.pack``/``unpack``) used only on the pack side or
   only on the unpack side across the scanned files — the signature of a
-  one-sided format change tearing the frame layout.
+  one-sided format change tearing the frame layout;
+* ``wire-frame-oneway`` — a ``put_X_frame`` encoder in protocol.py with
+  no ``recv_X_frame``/``read_X_frame`` decoder (or vice versa).  The
+  Assignment's trailing sections (blob park frames, the schedule frame)
+  are encoded/decoded through these helper pairs; a one-sided addition
+  desynchronizes every field after it — the Python client then misparses
+  the stream, silently;
+* ``wire-native-prefix`` — a ``Get*`` read in comm.cc's RecvAssignment
+  AFTER the ``epoch_`` assignment.  The tracker appends epoch-trailing
+  sections (rank_map, schedule) that the native client must never read:
+  its prefix contract is "read up to the epoch and close", and a read
+  past it blocks on bytes whose layout Python owns.
 """
 
 from __future__ import annotations
@@ -31,9 +42,14 @@ from tools.tpulint.core import Finding, const_str, parse_python, rel
 RULE_MISMATCH = "wire-cmd-mismatch"
 RULE_UNHANDLED = "wire-cmd-unhandled"
 RULE_ONEWAY = "wire-struct-oneway"
+RULE_FRAME_ONEWAY = "wire-frame-oneway"
+RULE_NATIVE_PREFIX = "wire-native-prefix"
 
 _NATIVE_CONST_RE = re.compile(
     r"k(Cmd|Magic)([A-Za-z0-9]+)\s*=\s*(0[xX][0-9a-fA-F]+|\d+)")
+_FRAME_PUT_RE = re.compile(r"^put_([a-z0-9_]+)_frame$")
+_FRAME_GET_RE = re.compile(r"^(?:recv|read)_([a-z0-9_]+)_frame$")
+_NATIVE_GET_RE = re.compile(r"\bGet(?:U32|I32|Str)\s*\(")
 
 
 def python_wire_consts(protocol_py: Path) -> dict[str, tuple[int, int]]:
@@ -155,12 +171,104 @@ def _struct_uses(files: list[Path],
     return uses
 
 
+def frame_pairs(protocol_py: Path) -> dict[str, dict[str, int]]:
+    """frame name -> {"put": line} / {"get": line} from protocol.py's
+    module-level ``put_X_frame`` / ``recv_X_frame``/``read_X_frame``
+    function definitions."""
+    tree = parse_python(protocol_py)
+    out: dict[str, dict[str, int]] = {}
+    if tree is None:
+        return out
+    for node in tree.body:
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        m = _FRAME_PUT_RE.match(node.name)
+        if m is not None:
+            out.setdefault(m.group(1), {})["put"] = node.lineno
+            continue
+        m = _FRAME_GET_RE.match(node.name)
+        if m is not None:
+            out.setdefault(m.group(1), {})["get"] = node.lineno
+    return out
+
+
+def check_frame_symmetry(protocol_py: Path, root: Path) -> list[Finding]:
+    findings: list[Finding] = []
+    proto_rel = rel(protocol_py, root)
+    for name, sides in sorted(frame_pairs(protocol_py).items()):
+        if "put" in sides and "get" not in sides:
+            findings.append(Finding(
+                RULE_FRAME_ONEWAY, proto_rel, sides["put"],
+                f"put_{name}_frame has no recv_{name}_frame/"
+                f"read_{name}_frame decoder — a one-sided frame change "
+                f"desynchronizes every field after it",
+                token=f"put:{name}"))
+        elif "get" in sides and "put" not in sides:
+            findings.append(Finding(
+                RULE_FRAME_ONEWAY, proto_rel, sides["get"],
+                f"frame decoder for {name!r} has no put_{name}_frame "
+                f"encoder — it parses bytes nothing ever writes",
+                token=f"get:{name}"))
+    return findings
+
+
+def check_native_prefix(comm_cc: Path, root: Path) -> list[Finding]:
+    """Flag ``Get*`` reads in comm.cc's RecvAssignment after the
+    ``epoch_`` assignment — the native client's prefix contract (read up
+    to the epoch, close; everything after is Python-owned trailing
+    data).  Missing file / function / epoch read -> no findings (fixture
+    trees without a native client are legitimate)."""
+    try:
+        text = comm_cc.read_text(encoding="utf-8", errors="replace")
+    except OSError:
+        return []
+    start = text.find("Comm::RecvAssignment")
+    if start < 0:
+        return []
+    open_brace = text.find("{", start)
+    if open_brace < 0:
+        return []
+    depth = 0
+    end = len(text)
+    for i in range(open_brace, len(text)):
+        if text[i] == "{":
+            depth += 1
+        elif text[i] == "}":
+            depth -= 1
+            if depth == 0:
+                end = i
+                break
+    body = text[open_brace:end]
+    body_line0 = text[:open_brace].count("\n") + 1
+    lines = body.splitlines()
+    epoch_at = None
+    for i, line in enumerate(lines):
+        if "epoch_ =" in line:
+            epoch_at = i
+    if epoch_at is None:
+        return []
+    findings: list[Finding] = []
+    comm_rel = rel(comm_cc, root)
+    for i in range(epoch_at + 1, len(lines)):
+        m = _NATIVE_GET_RE.search(lines[i])
+        if m is not None:
+            findings.append(Finding(
+                RULE_NATIVE_PREFIX, comm_rel, body_line0 + i,
+                "RecvAssignment reads past the epoch — the assignment's "
+                "trailing sections (rank_map, schedule) are Python-owned; "
+                "the native prefix contract is 'read up to the epoch and "
+                "close'",
+                token=f"past-epoch:{m.group(0).rstrip('(').strip()}"))
+    return findings
+
+
 def check_wire(
     protocol_py: Path,
     tracker_py: Path,
     comm_h: Path,
     struct_files: list[Path],
     root: Path,
+    comm_cc: Path | None = None,
 ) -> list[Finding]:
     findings: list[Finding] = []
     py_consts = python_wire_consts(protocol_py)
@@ -209,4 +317,8 @@ def check_wire(
                 f"struct format {fmt!r} is unpacked here but never packed "
                 f"anywhere in the protocol surface",
                 token=f"unpack:{fmt}"))
+
+    findings += check_frame_symmetry(protocol_py, root)
+    if comm_cc is not None:
+        findings += check_native_prefix(comm_cc, root)
     return findings
