@@ -87,6 +87,13 @@ ELASTIC_CHILD_TIMEOUT = 120.0
 # TPU budget like the other riders; RABIT_BENCH_SCHED=0 skips it.
 SCHED_BENCH = os.environ.get("RABIT_BENCH_SCHED", "1") != "0"
 SCHED_CHILD_TIMEOUT = 120.0
+# Quorum ablation (ISSUE 8): rounds/sec under an injected 8x compute
+# straggler, quorum off vs on vs on+i8 (tools/consensus_bench.py
+# --quorum-ablation; doc/partial_allreduce.md) in a CPU child — the
+# straggler-tolerance trajectory.  ~10s, deducted from the TPU budget
+# like the other riders; RABIT_BENCH_QUORUM=0 skips it.
+QUORUM_BENCH = os.environ.get("RABIT_BENCH_QUORUM", "1") != "0"
+QUORUM_CHILD_TIMEOUT = 180.0
 
 
 def log(msg):
@@ -412,6 +419,35 @@ def run_sched_bench(timeout=SCHED_CHILD_TIMEOUT):
     return lines
 
 
+def run_quorum_bench(timeout=QUORUM_CHILD_TIMEOUT):
+    """Quorum ablation record (tools/consensus_bench.py
+    --quorum-ablation) in a child: live elastic workers + an injected
+    compute straggler (threads + sleeps; a child so a wedged run cannot
+    stall the driver).  Returns the record list, empty on
+    timeout/failure — the curve must never cost the main metric."""
+    cmd = [sys.executable,
+           os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "tools", "consensus_bench.py"),
+           "--quorum-ablation"]
+    lines = []
+    try:
+        r = subprocess.run(cmd, timeout=timeout, capture_output=True,
+                           text=True)
+        if r.returncode == 0:
+            for line in r.stdout.strip().splitlines():
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if isinstance(rec, dict) and rec.get("bench") == "quorum_ablation":
+                    lines.append(rec)
+        else:
+            log(f"quorum ablation child rc={r.returncode}")
+    except subprocess.TimeoutExpired:
+        log(f"quorum ablation child timed out after {timeout:.0f}s")
+    return lines
+
+
 def probe_device(timeout=45.0) -> bool:
     """Fast TPU liveness check in a throwaway child: a wedged axon tunnel
     hangs at backend init (holding jax's lock forever), and burning the
@@ -576,6 +612,14 @@ def main():
                          min(tpu_budget, 300.0))
         log(f"schedule bench: {len(sched_lines)} line(s); "
             f"TPU budget now {tpu_budget:.0f}s")
+    quorum_lines = []
+    if QUORUM_BENCH:
+        t_q = time.time()
+        quorum_lines = run_quorum_bench()
+        tpu_budget = max(tpu_budget - (time.time() - t_q),
+                         min(tpu_budget, 300.0))
+        log(f"quorum bench: {len(quorum_lines)} line(s); "
+            f"TPU budget now {tpu_budget:.0f}s")
     res = try_tpu_within_budget(tpu_budget)
     n_rows = N_ROWS
     if not isinstance(res, dict):
@@ -605,6 +649,8 @@ def main():
             rec["elastic"] = elastic_lines
         if sched_lines:
             rec["schedule_ablation"] = sched_lines
+        if quorum_lines:
+            rec["quorum_ablation"] = quorum_lines
         print(json.dumps(rec), flush=True)
         return
     device_time = res["device_time"]
@@ -650,6 +696,8 @@ def main():
         rec["elastic"] = elastic_lines
     if sched_lines:
         rec["schedule_ablation"] = sched_lines
+    if quorum_lines:
+        rec["quorum_ablation"] = quorum_lines
     print(json.dumps(rec), flush=True)
 
 

@@ -21,7 +21,7 @@ from typing import Any, Callable
 
 import numpy as np
 
-from rabit_tpu import compress, obs
+from rabit_tpu import compress, obs, quorum
 from rabit_tpu.config import Config
 from rabit_tpu.engine import create_engine
 from rabit_tpu.engine.base import MAX, MIN, SUM, BITOR, DTYPE_ENUM, Engine
@@ -112,6 +112,10 @@ def init(args: list[str] | None = None, **overrides: Any) -> None:
         args = [a for a in sys.argv[1:] if "=" in a]
     args = [a.decode() if isinstance(a, bytes) else a for a in args]
     cfg = Config(args, {k: str(v) for k, v in overrides.items()})
+    # Quorum policy (rabit_tpu/quorum, doc/partial_allreduce.md): resolve
+    # BEFORE any engine spins up, so a typo'd rabit_quorum fails loudly
+    # with nothing to tear down.
+    qpol = quorum.resolve(cfg)
     _engine = create_engine(cfg)
     _engine.init()
     # Observability wiring: flight recorder capacity, hang/SIGTERM dump
@@ -129,6 +133,17 @@ def init(args: list[str] | None = None, **overrides: Any) -> None:
         broadcast=pol.broadcast or "identity",
         checkpoint=pol.checkpoint or "identity",
     )
+    # Record the resolved quorum policy so a cross-rank config skew is
+    # visible in the dumps.  The engines' own collectives stay exact —
+    # the quorum data plane is the tracker + schedule-aware executor
+    # contract (ElasticWorker), the same seam the planned rings ride.
+    if qpol["quorum"]:
+        obs.record_event(
+            "quorum_policy",
+            quorum=qpol["quorum"],
+            wait_sec=qpol["wait_sec"],
+            flag_after=qpol["flag_after"],
+        )
     obs.record_event(
         "engine_ready",
         engine=type(_engine).__name__,

@@ -499,6 +499,16 @@ class ElasticScheduleResult:
     n_repaired: int = 0       # schedule_repaired waves committed
     dst_wait_s: float = 0.0   # slow_link runs: dst's cumulative link wait
     dst_slow_reports: int = 0
+    # quorum runs (rabit_tpu.quorum, doc/partial_allreduce.md)
+    quorum: str = ""                  # the rabit_quorum spec this run used
+    straggler: tuple | None = None    # (rank, delay_s, heal_version)
+    n_quorum_met: int = 0             # rounds decided with exclusions
+    n_corrections_folded: int = 0
+    n_corrections_dropped: int = 0    # epoch boundaries settling by drop
+    #: task "0"'s mean inter-commit gap over the steady rounds — the
+    #: live-rank round cadence the quorum ablation compares (a straggler
+    #: shows up here under quorum off, and must NOT under quorum on)
+    cadence_s: float = 0.0
 
 
 def run_elastic_schedule(seed: int, world: int | None = None,
@@ -507,7 +517,14 @@ def run_elastic_schedule(seed: int, world: int | None = None,
                          schedule: str | None = None,
                          slow_link: tuple[int, int, float] | None = None,
                          repair: bool = True,
-                         niter: int | None = None) -> ElasticScheduleResult:
+                         niter: int | None = None,
+                         straggler: tuple | None = None,
+                         quorum: str = "",
+                         quorum_wait: float = 0.15,
+                         quorum_flag_after: int = 0,
+                         codec: str = "",
+                         mix_faults: bool = False,
+                         iter_sleep: float | None = None) -> ElasticScheduleResult:
     """One fuzzed shrink/grow scenario (deterministic per seed).
 
     A seeded mix of elastic failure shapes against a real elastic tracker:
@@ -543,6 +560,29 @@ def run_elastic_schedule(seed: int, world: int | None = None,
     unrepaired control arm the bench compares against).  slow_link runs
     disable the sampled kills/spares so the two arms differ only in the
     repair.
+
+    ``straggler = (rank, delay_s)`` or ``(rank, delay_s, heal_version)``
+    is the COMPUTE-side fault (distinct from ``slow_link``'s network
+    delay): that rank's contribution takes ``delay_s`` extra seconds for
+    every version up to ``heal_version`` (default: never heals).  Pair
+    it with ``quorum`` (a ``rabit_quorum`` spec for tracker AND workers;
+    ``quorum_wait``/``quorum_flag_after``/``codec`` ride along) to
+    exercise the K-of-N partial allreduce: excluded rounds, correction
+    landing, and epoch-boundary drops.  A straggler run disables the
+    sampled kills/spares for a clean arm unless ``mix_faults=True`` (the
+    straggler+quorum+kill campaigns); the sampled victim set never
+    contains the straggler or task "0".
+
+    Quorum correctness asserts: every completed worker's final state is
+    BITWISE IDENTICAL; with a single epoch the state equals the closed
+    form minus exactly the contributions the exclusion records name as
+    never-folded (exact accounting from ``quorum_met`` /
+    ``correction_folded`` events); across recovery waves (records of an
+    aborted epoch may describe rounds that were then redone exactly) the
+    state is sandwiched elementwise between the closed form and the
+    closed form minus every potentially-missing contribution.  Codec
+    runs (lossy wire) assert the bitwise cross-rank identity and a loose
+    closeness to the closed form instead.
     """
     from rabit_tpu.elastic.client import ElasticWorker
     from rabit_tpu.elastic.rebalance import shard_slice
@@ -552,26 +592,47 @@ def run_elastic_schedule(seed: int, world: int | None = None,
     n_spares = rng.choice([0, 1, 2])
     drawn_niter = rng.choice([3, 4, 5])
     niter = int(niter) if niter is not None else drawn_niter
-    iter_sleep = rng.choice([0.05, 0.1])
+    drawn_sleep = rng.choice([0.05, 0.1])
+    iter_sleep = float(iter_sleep) if iter_sleep is not None else drawn_sleep
     if schedule is None:
         schedule = rng.choice(["auto", "tree", "ring", "swing"])
     if slow_link is not None:
         n_spares = 0  # a clean A/B: no confounding resize traffic
+    s_rank, s_delay, s_heal = -1, 0.0, 0
+    if straggler is not None:
+        s_rank, s_delay = int(straggler[0]), float(straggler[1])
+        s_heal = int(straggler[2]) if len(straggler) > 2 else niter + 1
+        if not (0 <= s_rank < world) or s_delay < 0:
+            raise ValueError(f"bad straggler {straggler!r} for world {world}")
+        if not mix_faults:
+            n_spares = 0  # a clean quorum arm: only the compute fault
     n_rows, n_bins = 8 * world, 8
     data = np.array([rng.randrange(n_bins) for _ in range(n_rows)])
+    # codec runs fold float32 (the compress contract); exact runs keep
+    # the int64 bitwise closed form.
+    fold_dtype = np.float32 if codec else np.int64
 
     def contribution(version: int, w: int, r: int) -> np.ndarray:
         time.sleep(iter_sleep)
+        if r == s_rank and version <= s_heal:
+            time.sleep(s_delay)  # the compute-side straggler fault
         rows = data[shard_slice(n_rows, w, r)]
-        return np.bincount(rows, minlength=n_bins).astype(np.int64) * version
+        return np.bincount(rows, minlength=n_bins).astype(fold_dtype) * version
 
-    expected = sum(np.bincount(data, minlength=n_bins).astype(np.int64) * v
+    def per_contribution(version: int, w: int, r: int) -> np.ndarray:
+        """One rank's contribution WITHOUT the fault sleeps — the exact
+        term the quorum accounting subtracts for a never-folded block."""
+        rows = data[shard_slice(n_rows, w, r)]
+        return np.bincount(rows, minlength=n_bins).astype(fold_dtype) * version
+
+    expected = sum(np.bincount(data, minlength=n_bins).astype(fold_dtype) * v
                    for v in range(1, niter + 1))
 
     n_kills = rng.randint(0, min(world - 1, 2))
-    victims = rng.sample([str(i) for i in range(1, world)], n_kills)
+    pool = [str(i) for i in range(1, world) if i != s_rank]
+    victims = rng.sample(pool, min(n_kills, len(pool)))
     kill_at = {t: rng.randint(2, max(niter, 2)) for t in victims}
-    if slow_link is not None:
+    if slow_link is not None or (straggler is not None and not mix_faults):
         kill_at = {}
     spare_specs = []
     for i in range(n_spares):
@@ -587,7 +648,9 @@ def run_elastic_schedule(seed: int, world: int | None = None,
     # the knobs").
     tracker = Tracker(world, quiet=quiet, conn_timeout_sec=1.0,
                       shrink_after_sec=1.5, promote_after_sec=0.1,
-                      schedule=schedule, sched_repair=repair).start()
+                      schedule=schedule, sched_repair=repair,
+                      quorum=quorum,
+                      quorum_flag_after=quorum_flag_after).start()
     addr = (tracker.host, tracker.port)
     t0 = time.monotonic()
     results: dict[str, object] = {}
@@ -603,13 +666,18 @@ def run_elastic_schedule(seed: int, world: int | None = None,
     for i in range(world):
         tid = str(i)
         fail = ("die", kill_at[tid]) if tid in kill_at else None
-        # slow_link runs need a longer link patience: the degraded hop
-        # legitimately stalls frames without the peer being dead.
+        # slow_link/straggler runs need a longer link patience: a
+        # degraded hop (or a legacy-mode recv blocked on a computing
+        # straggler) legitimately stalls frames without the peer dying.
         link_to = 1.0 if slow_link is None else max(1.0, 4 * slow_link[2])
+        if straggler is not None:
+            link_to = max(link_to, 4 * s_delay)
         w = ElasticWorker(addr, tid, contribution, niter,
                           heartbeat_sec=0.15, rpc_timeout=2.0,
                           wave_timeout=10.0, link_timeout=link_to,
-                          deadline_sec=deadline_sec, fail=fail)
+                          deadline_sec=deadline_sec, fail=fail,
+                          quorum=quorum, quorum_wait=quorum_wait,
+                          codec=codec)
         workers.append(w)
         threads.append(threading.Thread(target=run_worker, args=(w,),
                                         daemon=True))
@@ -643,7 +711,8 @@ def run_elastic_schedule(seed: int, world: int | None = None,
                           wave_timeout=10.0, link_timeout=1.0,
                           deadline_sec=max(deadline_sec
                                            - (time.monotonic() - t0), 1.0),
-                          fail=fail)
+                          fail=fail, quorum=quorum,
+                          quorum_wait=quorum_wait, codec=codec)
         with lock:
             spare_workers.append(w)
         run_worker(w)
@@ -701,10 +770,67 @@ def run_elastic_schedule(seed: int, world: int | None = None,
             raise AssertionError(
                 f"seed={seed}: task {res.task_id} completed at version "
                 f"{res.final_version}, wanted {niter}")
-        if not np.array_equal(res.state, expected):
+    # -- cross-rank determinism: every completed worker reproduced the
+    # SAME bits, no matter which quorum records, corrections, codecs, or
+    # world sizes it passed through.
+    ref = completed[0].state if completed else None
+    for res in completed[1:]:
+        if not np.array_equal(res.state, ref):
             raise AssertionError(
-                f"seed={seed}: task {res.task_id} state {res.state!r} != "
-                f"expected {expected!r} (worlds seen: {res.worlds})")
+                f"seed={seed}: task {res.task_id} state diverges bitwise "
+                f"from task {completed[0].task_id}")
+    # -- value correctness against the closed form.
+    qm = [e for e in tracker.events if e["kind"] == "quorum_met"]
+    folded = {(e["src_version"], e["rank"])
+              for e in tracker.events if e["kind"] == "correction_folded"}
+    missing = {(e["version"], r, e["world"])
+               for e in qm for r in e["excluded"]}
+    missing = {(sv, r, w) for (sv, r, w) in missing if (sv, r) not in folded}
+    n_epochs = len(tracker.elastic.history)
+    if ref is not None:
+        if not quorum:
+            if not np.array_equal(ref, expected):
+                raise AssertionError(
+                    f"seed={seed}: state {ref!r} != expected {expected!r}")
+        elif codec:
+            # lossy wire: the bitwise contract is cross-rank identity
+            # (asserted above); the value has to be close to the
+            # quorum-adjusted closed form (missing mass subtracted).
+            adjusted = expected.copy()
+            for sv, r, w in missing:
+                adjusted = adjusted - per_contribution(sv, w, r)
+            tol = 0.05 * float(np.max(np.abs(expected))) + 1.0
+            if n_epochs <= 1:
+                close = np.allclose(ref, adjusted, atol=tol)
+            else:
+                close = bool(np.all(ref <= expected + tol)
+                             and np.all(ref >= adjusted - tol))
+            if not close:
+                raise AssertionError(
+                    f"seed={seed}: codec state {ref!r} too far from "
+                    f"quorum-adjusted {adjusted!r} (tol {tol})")
+        elif n_epochs <= 1:
+            # single epoch: the exclusion records account EXACTLY for
+            # every never-folded contribution.
+            adjusted = expected.copy()
+            for sv, r, w in missing:
+                adjusted = adjusted - per_contribution(sv, w, r)
+            if not np.array_equal(ref, adjusted):
+                raise AssertionError(
+                    f"seed={seed}: state {ref!r} != quorum-adjusted "
+                    f"{adjusted!r} (missing {sorted(missing)})")
+        else:
+            # recovery waves redo rounds: a record from an aborted epoch
+            # may describe a round that then folded fully, so the exact
+            # set is unknowable from events alone — sandwich instead
+            # (contributions are non-negative, nothing folds twice).
+            floor = expected.copy()
+            for sv, r, w in missing:
+                floor = floor - per_contribution(sv, w, r)
+            if not (np.all(ref <= expected) and np.all(ref >= floor)):
+                raise AssertionError(
+                    f"seed={seed}: state {ref!r} outside "
+                    f"[{floor!r}, {expected!r}]")
     # -- membership sanity on the tracker's committed timeline.
     waves = [e for e in tracker.events if e["kind"] == "wave"]
     epochs = [e["epoch"] for e in waves]
@@ -719,6 +845,10 @@ def run_elastic_schedule(seed: int, world: int | None = None,
                 f"dense for world {e['world']}")
     worlds_seen = sorted({e["world"] for e in waves})
     dst_res = results.get(str(slow_link[1])) if slow_link is not None else None
+    cadence = 0.0
+    ct = getattr(results.get("0"), "commit_times", None) or {}
+    if niter >= 3 and 1 in ct and (niter - 1) in ct:
+        cadence = (ct[niter - 1] - ct[1]) / (niter - 2)
     return ElasticScheduleResult(
         seed=seed, world=world, n_spares=n_spares, niter=niter,
         n_completed=len(completed), n_died=len(died),
@@ -732,4 +862,13 @@ def run_elastic_schedule(seed: int, world: int | None = None,
                        if e["kind"] == "schedule_repaired"),
         dst_wait_s=getattr(dst_res, "wait_prev_s", 0.0),
         dst_slow_reports=getattr(dst_res, "slow_reports", 0),
+        quorum=quorum,
+        straggler=(s_rank, s_delay, s_heal) if straggler is not None
+        else None,
+        n_quorum_met=len(qm),
+        n_corrections_folded=sum(1 for e in tracker.events
+                                 if e["kind"] == "correction_folded"),
+        n_corrections_dropped=sum(1 for e in tracker.events
+                                  if e["kind"] == "correction_dropped"),
+        cadence_s=round(cadence, 6),
     )

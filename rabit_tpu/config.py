@@ -138,6 +138,22 @@ DEFAULTS: dict[str, str] = {
     "rabit_sched_mesh": "",
     "rabit_sched_repair": "1",
     "rabit_sched_wait_share": "0.25",
+    # Partial (quorum) allreduce (rabit_tpu/quorum,
+    # doc/partial_allreduce.md).  rabit_quorum: a fraction in (0,1]
+    # ("0.67" = two thirds of the current world) or an integer count —
+    # a collective round completes once that many contributions have
+    # folded; stragglers' late blocks land as exact correction terms at
+    # the next round boundary after delivery.  Empty (default) keeps
+    # the legacy exact lockstep collective; "1.0" runs the quorum wire
+    # but never excludes (bitwise identical to legacy).
+    # rabit_quorum_wait_sec is the executor's per-round deadline before
+    # it reports a partial quorum (and before a silent upstream rank is
+    # skipped around); rabit_quorum_flag_after feeds a rank excluded
+    # that many consecutive rounds into the schedule-repair avoid set
+    # (0 disables the feed).
+    "rabit_quorum": "",
+    "rabit_quorum_wait_sec": "0.35",
+    "rabit_quorum_flag_after": "3",
     # Cross-rank tracing (rabit_tpu/obs/trace.py, tools/trace_tool.py).
     # rabit_trace_exit=1: dump the flight ring as flight-*-exit.jsonl at
     # finalize, so CLEAN runs leave the per-rank evidence the job-wide

@@ -22,6 +22,18 @@ epoch's wall time, reports the link as degraded (a ``slow_link`` print
 the tracker converts to a ``link_degraded`` event) — the live telemetry
 the next wave's repair plan consumes.
 
+Quorum mode (``quorum=`` spec; rabit_tpu.quorum,
+doc/partial_allreduce.md) replaces the lockstep allgather with a
+straggler-tolerant round: tagged blocks flood the planned ring augmented
+by SKIP links (a successor past ``quorum_wait`` dials around its silent
+predecessor; the upstream rank tees the flow past the straggler), the
+round folds once the tracker's frozen K-of-N exclusion record says so,
+and a straggler's late blocks land as exact correction terms at the next
+record after delivery — with the final round always exact, and every
+fold bitwise identical on every rank, under replay, and after recovery.
+``codec=`` composes the PR 5 wire codecs into both the legacy and quorum
+paths (deterministic rank-symmetric encode, rank-order decode-fold).
+
 Failure shape: any link error mid-collective abandons the epoch — links
 close, the worker re-checks-in with ``CMD_RECOVER``, and the next wave
 (same size after a spare promotion, smaller after a shrink, larger after
@@ -42,7 +54,9 @@ for Python-side workloads, and the seam the engines hook via
 
 from __future__ import annotations
 
+import json
 import pickle
+import select
 import socket
 import threading
 import time
@@ -82,6 +96,20 @@ class ElasticResult:
     wait_prev_s: float = 0.0
     #: slow_link reports this worker sent (at most one per epoch)
     slow_reports: int = 0
+    # -- quorum mode (rabit_tpu.quorum, doc/partial_allreduce.md) --
+    #: rounds folded under a tracker-agreed exclusion record
+    quorum_rounds: int = 0
+    #: rounds whose record excluded at least one rank
+    excluded_rounds: int = 0
+    #: correction terms (late blocks) this worker folded
+    corrections_folded: int = 0
+    #: rounds this worker skipped contributing to while catching up
+    #: (the bounded-staleness catch-up: the group's record had already
+    #: excluded it, so no correction debt is created)
+    skipped_contributions: int = 0
+    #: monotonic commit time per version (quorum benches derive the
+    #: live-rank round cadence from these)
+    commit_times: dict = field(default_factory=dict)
 
 
 class ElasticWorker:
@@ -114,6 +142,9 @@ class ElasticWorker:
         fail: tuple | None = None,
         advertise_port: int | None = None,
         slow_report_share: float = 0.0,
+        quorum: str = "",
+        quorum_wait: float = 0.35,
+        codec: str = "",
     ):
         self.tracker = (tracker[0], int(tracker[1]))
         self.task_id = task_id
@@ -153,6 +184,39 @@ class ElasticWorker:
         self._epoch_started = 0.0
         self._epoch_reported = False
         self._n_slow_reports = 0
+        # Quorum mode (rabit_tpu.quorum, doc/partial_allreduce.md): the
+        # K-of-N spec ("" = legacy exact collectives), the per-round
+        # deadline before reporting a partial quorum / skipping a silent
+        # upstream rank, and an optional wire codec (rabit_tpu.compress;
+        # deterministic rank-symmetric encode, rank-order decode-fold —
+        # i8 + quorum is the median-tracking fast path).
+        self.quorum_spec = str(quorum or "")
+        if self.quorum_spec:
+            from rabit_tpu.quorum import parse_spec
+
+            parse_spec(self.quorum_spec)  # typo'd quorum fails at build
+        self.quorum_wait = float(quorum_wait)
+        self.codec_name = str(codec or "")
+        self._codec = None
+        if self.codec_name:
+            from rabit_tpu.compress import get_codec
+
+            self._codec = get_codec(self.codec_name)
+        # per-epoch quorum round state (cleared in _close_links)
+        self._qframes: dict[tuple[int, int], bytes] = {}  # (v, origin)
+        self._qseen: set[tuple[int, int]] = set()
+        self._qagreed_prev: set[tuple[int, int]] = set()
+        self._known_late: set[int] = set()
+        self._skip_in: list[socket.socket] = []   # we dialed around someone
+        self._tee_out: list[socket.socket] = []   # someone dialed around us
+        self._skip_from = -1
+        # job-lifetime quorum accounting (ElasticResult)
+        self._qlike: np.ndarray | None = None     # decode template
+        self._q_rounds = 0
+        self._q_excluded_rounds = 0
+        self._q_corrections = 0
+        self._q_skipped = 0
+        self._commit_times: dict[int, float] = {}
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -415,6 +479,22 @@ class ElasticWorker:
             except OSError:
                 pass
         self._links.clear()
+        # Quorum round state is epoch-scoped: skip/tee sockets die with
+        # the ring links, and retained frames/records cannot survive a
+        # membership wave (ranks renumber — doc/partial_allreduce.md,
+        # "Epoch boundaries").
+        for s in self._skip_in + self._tee_out:
+            try:
+                s.close()
+            except OSError:
+                pass
+        self._skip_in = []
+        self._tee_out = []
+        self._qframes.clear()
+        self._qseen.clear()
+        self._qagreed_prev.clear()
+        self._known_late.clear()
+        self._skip_from = -1
 
     @staticmethod
     def _send_frame(sock: socket.socket, payload: bytes) -> None:
@@ -478,15 +558,322 @@ class ElasticWorker:
             self._send_frame(self._links[self._ring_next], payload)
         return payload
 
+    def _encode_block(self, contrib: np.ndarray) -> bytes:
+        """One rank's wire block: raw bytes, or the configured codec's
+        deterministic rank-symmetric encoding (rabit_tpu.compress)."""
+        if self._codec is None:
+            return contrib.tobytes()
+        if contrib.dtype != np.float32:
+            raise ValueError(
+                f"codec={self.codec_name!r} needs float32 contributions, "
+                f"got {contrib.dtype}")
+        return self._codec.encode(contrib)
+
+    def _decode_block(self, blob: bytes, like: np.ndarray) -> np.ndarray:
+        """Decode one wire block back into ``like``'s shape/dtype.  Every
+        rank decodes the identical bytes with the identical codec, so the
+        rank-order fold stays bitwise identical."""
+        if self._codec is None:
+            return np.frombuffer(blob, dtype=like.dtype).reshape(like.shape)
+        return self._codec.decode(blob, int(like.size)).reshape(like.shape)
+
     def _allreduce_sum(self, asg: P.Assignment,
                        contrib: np.ndarray) -> np.ndarray:
         """Rank-order fold of the allgathered contributions: bitwise
         identical on every rank, and — for exact dtypes — identical
         across world sizes that partition the same dataset."""
         contrib = np.ascontiguousarray(contrib)
-        parts = self._ring_allgather(asg, contrib.tobytes())
-        return refold([np.frombuffer(b, dtype=contrib.dtype)
-                       .reshape(contrib.shape) for b in parts])
+        parts = self._ring_allgather(asg, self._encode_block(contrib))
+        return refold([self._decode_block(b, contrib) for b in parts])
+
+    # -- quorum rounds (rabit_tpu.quorum, doc/partial_allreduce.md) ----------
+    #
+    # A quorum round replaces the lockstep ring allgather with a flood of
+    # TAGGED blocks ``(version, origin, payload)`` over the planned ring
+    # augmented by skip links: every first-seen block is stored and fanned
+    # out (ring next + tees), so duplicates are idempotent and the flow
+    # stays connected even when a straggler's position is routed around.
+    # The round then fetches the tracker's frozen exclusion record (one
+    # CMD_QUORUM RPC), drains until it holds every agreed block and every
+    # decided correction, and folds in rank order — bitwise identical on
+    # every rank, under replay, and after recovery.
+
+    def _quorum_on(self) -> bool:
+        return bool(self.quorum_spec)
+
+    def _q_have(self, v: int) -> set[int]:
+        """Ranks whose version-``v`` block this worker currently holds."""
+        return {r for (vv, r) in self._qframes if vv == v}
+
+    def _qpost(self, asg: P.Assignment, v: int, origin: int,
+               payload: bytes) -> bool:
+        """Store a tagged block on first sight and fan it out to the ring
+        successor plus every tee.  Returns True when the block was new."""
+        key = (v, origin)
+        if key in self._qseen:
+            return False
+        self._qseen.add(key)
+        self._qframes[key] = payload
+        frame = P.put_block_frame(v, origin, payload)
+        if asg.world_size > 1 and self._ring_next in self._links:
+            self._send_frame(self._links[self._ring_next], frame)
+        for s in list(self._tee_out):
+            try:
+                s.sendall(P.put_u32(len(frame)) + frame)
+            except OSError:
+                self._drop_tee(s)
+        return True
+
+    def _drop_tee(self, s: socket.socket) -> None:
+        try:
+            s.close()
+        except OSError:
+            pass
+        if s in self._tee_out:
+            self._tee_out.remove(s)
+
+    def _drop_skip(self, s: socket.socket) -> None:
+        try:
+            s.close()
+        except OSError:
+            pass
+        if s in self._skip_in:
+            self._skip_in.remove(s)
+
+    def _q_accept(self, asg: P.Assignment) -> None:
+        """Accept one mid-round dial: a MAGIC_SKIP hello registers a tee
+        (the dialer is routing around our silent downstream neighbor) and
+        is replayed every retained frame so it can fold the rounds it is
+        missing; anything else (a stale MAGIC_LINK dialer from a dead
+        epoch) is dropped — exactly _build_links' stale-dialer rule."""
+        self._listen.settimeout(0.2)
+        try:
+            s, _ = self._listen.accept()
+        except (socket.timeout, OSError):
+            return
+        try:
+            s.settimeout(self.link_timeout)
+            magic = P.get_u32(s)
+            if magic != P.MAGIC_SKIP:
+                s.close()
+                return
+            _peer, epoch, _since = P.read_skip_frame(s)
+        except (ConnectionError, OSError, ValueError):
+            try:
+                s.close()
+            except OSError:
+                pass
+            return
+        if epoch != asg.epoch:
+            try:
+                s.close()
+            except OSError:
+                pass
+            return
+        try:
+            for (v, origin) in sorted(self._qframes):
+                frame = P.put_block_frame(v, origin,
+                                          self._qframes[(v, origin)])
+                s.sendall(P.put_u32(len(frame)) + frame)
+        except OSError:
+            try:
+                s.close()
+            except OSError:
+                pass
+            return
+        self._tee_out.append(s)
+
+    def _q_skip_dial(self, asg: P.Assignment, v: int) -> None:
+        """Route around a silent upstream (the ISSUE's 'a rank past the
+        quorum deadline is skipped by its ring successor'): dial the
+        ring-order predecessor of the current frame source and receive
+        the flow from there.  Repeated stalls walk further back — two
+        adjacent stragglers are skipped one dial at a time."""
+        world = asg.world_size
+        if world <= 2:
+            return  # no third rank to route through
+        cur = self._skip_from if self._skip_from >= 0 else self._ring_prev
+        pos = self._order.index(cur)
+        target = self._order[(pos - 1) % world]
+        if target == asg.rank or target == cur:
+            return
+        self._skip_from = target  # walk further back next stall regardless
+        try:
+            host, port = asg.peers[target]
+            s = socket.create_connection((host, port),
+                                         timeout=self.link_timeout)
+            s.settimeout(self.link_timeout)
+            s.sendall(P.put_skip_frame(asg.rank, asg.epoch, v))
+        except (OSError, KeyError):
+            return
+        self._skip_in.append(s)
+
+    def _qpump(self, asg: P.Assignment, tick: float = 0.05) -> bool:
+        """One bounded pass over every inbound source — the ring prev
+        link, any skip links, and the listen socket (peers dialing around
+        OUR silent neighbor).  Returns True when a new frame landed."""
+        ins: list[socket.socket] = []
+        if asg.world_size > 1 and self._ring_prev in self._links:
+            ins.append(self._links[self._ring_prev])
+        ins += self._skip_in
+        ins.append(self._listen)
+        try:
+            readable, _, _ = select.select(ins, [], [], tick)
+        except (OSError, ValueError):
+            raise EpochBroken("select failed on ring sockets")
+        progress = False
+        for s in readable:
+            if s is self._listen:
+                self._q_accept(asg)
+                continue
+            try:
+                data = self._recv_frame(s)
+            except EpochBroken:
+                if s in self._skip_in:
+                    self._drop_skip(s)  # redundant path died; ring remains
+                    continue
+                raise
+            try:
+                v, origin, payload = P.read_block_frame(data)
+            except ValueError:
+                continue  # torn/foreign frame from a stale-epoch writer
+            if not (0 <= origin < asg.world_size):
+                continue
+            if self._qpost(asg, v, origin, payload):
+                progress = True
+        return progress
+
+    def _q_rpc(self, asg: P.Assignment, v: int, have: list[int],
+               held: list[tuple[int, int]]) -> dict | None:
+        """One CMD_QUORUM report; returns the parsed reply or None on a
+        transport miss (the caller's bounded loop retries)."""
+        msg = json.dumps({"epoch": asg.epoch, "v": v, "have": have,
+                          "held": [list(t) for t in held]})
+        try:
+            reply = P.tracker_rpc(self.tracker[0], self.tracker[1],
+                                  P.CMD_QUORUM, self.task_id,
+                                  prev_rank=asg.rank, message=msg,
+                                  timeout=self.rpc_timeout, retries=1)
+            return reply if isinstance(reply, dict) else None
+        except (P.TrackerUnreachable, ValueError):
+            return None
+
+    def _quorum_allreduce(self, asg: P.Assignment, v: int,
+                          contrib: np.ndarray | None) -> np.ndarray:
+        """One K-of-N round: collect -> agree -> drain -> fold.
+
+        ``contrib=None`` is the catch-up shape: the group's record for
+        this round was already decided without us (frames for a LATER
+        round prove it), so we fold the frozen record and move on instead
+        of dragging an ever-growing correction chain — this is what
+        bounds the staleness.  The FINAL round is always exact (every
+        contribution must land before the job's last commit)."""
+        from rabit_tpu.quorum import quorum_count
+
+        world = asg.world_size
+        k = quorum_count(world, self.quorum_spec)
+        all_ranks = set(range(world))
+        exact = (k >= world) or (v >= self.niter)
+        if contrib is not None:
+            self._qpost(asg, v, asg.rank, self._encode_block(contrib))
+        if self._qlike is None:
+            if contrib is None:
+                raise EpochBroken("quorum catch-up before any contribution")
+            self._qlike = np.zeros_like(contrib)
+        deadline = min(time.monotonic() + self.wave_timeout, self.deadline)
+        # -- collect: pump until the expected blocks landed (known-late
+        # ranks are not waited for — after the first excluded round the
+        # straggler costs nothing per round) or the quorum deadline.
+        expected = set(all_ranks) if exact else (all_ranks
+                                                 - self._known_late)
+        if contrib is not None:
+            expected.add(asg.rank)
+        else:
+            expected.discard(asg.rank)
+        qdl = time.monotonic() + self.quorum_wait
+        last_progress = time.monotonic()
+        while not expected <= self._q_have(v):
+            self._check_deadline()
+            if time.monotonic() > deadline:
+                raise EpochBroken(f"quorum round v{v}: collect timed out")
+            if self._qpump(asg):
+                last_progress = time.monotonic()
+            elif time.monotonic() - last_progress > self.quorum_wait:
+                self._q_skip_dial(asg, v)
+                last_progress = time.monotonic()
+            if not exact and time.monotonic() > qdl:
+                break
+        # -- agree: fetch the round's frozen exclusion record.  EVERY
+        # rank consults the tracker every round — a rank that collected
+        # all N must still learn whether a slower reporter froze a
+        # smaller fold, or the bits diverge.
+        rec: dict | None = None
+        while rec is None:
+            self._check_deadline()
+            if time.monotonic() > deadline:
+                raise EpochBroken(f"quorum round v{v}: no record within "
+                                  f"bound")
+            have = sorted(self._q_have(v))
+            held = sorted((sv, r) for (sv, r) in self._qframes if sv < v)
+            reply = self._q_rpc(asg, v, have, held)
+            if reply is not None:
+                if reply.get("disabled"):
+                    raise EpochBroken(
+                        "worker runs quorum mode but the tracker has no "
+                        "quorum table (set Tracker(quorum=...))")
+                if reply.get("stale_epoch"):
+                    raise EpochBroken("quorum report hit a newer epoch")
+                if reply.get("decided"):
+                    rec = reply
+                    break
+            if self._qpump(asg):
+                last_progress = time.monotonic()
+            elif time.monotonic() - last_progress > self.quorum_wait:
+                self._q_skip_dial(asg, v)
+                last_progress = time.monotonic()
+        excluded = {int(r) for r in rec.get("excluded", ())}
+        corrections = sorted((int(sv), int(r))
+                             for sv, r in rec.get("corrections", ()))
+        # -- drain: the record is law — hold every agreed block and every
+        # decided correction before folding (they flow from whichever
+        # live rank the deciding reporter was).
+        need = ({(v, r) for r in all_ranks - excluded}
+                | set(corrections))
+        while not need <= set(self._qframes):
+            self._check_deadline()
+            if time.monotonic() > deadline:
+                missing = sorted(need - set(self._qframes))
+                raise EpochBroken(f"quorum round v{v}: agreed blocks "
+                                  f"never arrived: {missing}")
+            if self._qpump(asg):
+                last_progress = time.monotonic()
+            elif time.monotonic() - last_progress > self.quorum_wait:
+                self._q_skip_dial(asg, v)
+                last_progress = time.monotonic()
+        # -- fold, in rank order, corrections after the round's blocks in
+        # (src_version, rank) order: same blocks, same order, same bits
+        # on every rank.
+        like = self._qlike
+        agreed = sorted(all_ranks - excluded)
+        parts = [self._decode_block(self._qframes[(v, r)], like)
+                 for r in agreed]
+        parts += [self._decode_block(self._qframes[key], like)
+                  for key in corrections]
+        total = refold(parts)
+        # bookkeeping: remember who is late (next round's collect skips
+        # waiting on them), retire folded corrections, and retain only a
+        # one-round window of payloads for skip-dial catch-up.
+        self._q_rounds += 1
+        if excluded:
+            self._q_excluded_rounds += 1
+        self._q_corrections += len(corrections)
+        self._known_late = set(excluded)
+        for key in corrections:
+            self._qframes.pop(key, None)
+        for key in self._qagreed_prev:
+            self._qframes.pop(key, None)
+        self._qagreed_prev = {(v, r) for r in agreed}
+        return total
 
     # -- state agreement -----------------------------------------------------
 
@@ -554,6 +941,11 @@ class ElasticWorker:
         finally:
             res.wait_prev_s = round(self._wait_total_s, 6)
             res.slow_reports = self._n_slow_reports
+            res.quorum_rounds = self._q_rounds
+            res.excluded_rounds = self._q_excluded_rounds
+            res.corrections_folded = self._q_corrections
+            res.skipped_contributions = self._q_skipped
+            res.commit_times = dict(self._commit_times)
             self._stop_heartbeat()
             self._close_links()
             try:
@@ -600,12 +992,36 @@ class ElasticWorker:
                         res.state = self._state
                         return res
                     self._check_deadline()
-                    contrib = np.ascontiguousarray(
-                        self.contribution(v, asg.world_size, asg.rank))
-                    total = self._allreduce_sum(asg, contrib)
+                    if self._quorum_on():
+                        # Bounded-staleness catch-up: a frame for a LATER
+                        # round proves round v's record is already frozen
+                        # — without our block, so it excluded us.  Fold
+                        # the frozen record and rejoin the group's round
+                        # instead of contributing rounds the job has
+                        # moved past (doc/partial_allreduce.md).  Drain
+                        # the queued backlog first: a rank that just
+                        # finished a slow contribution hasn't looked at
+                        # its sockets since the round began.
+                        while self._qpump(asg, tick=0.0):
+                            pass
+                        ahead = max((vv for (vv, _r) in self._qseen),
+                                    default=0)
+                        contrib = None
+                        if ahead <= v:
+                            contrib = np.ascontiguousarray(
+                                self.contribution(v, asg.world_size,
+                                                  asg.rank))
+                        else:
+                            self._q_skipped += 1
+                        total = self._quorum_allreduce(asg, v, contrib)
+                    else:
+                        contrib = np.ascontiguousarray(
+                            self.contribution(v, asg.world_size, asg.rank))
+                        total = self._allreduce_sum(asg, contrib)
                     self._state = (total if self._state is None
                                    else self._state + total)
                     self._version = v
+                    self._commit_times[v] = time.monotonic()
                     if asg.rank == 0:
                         self._ship_blob()
                     if self._version < self.niter:

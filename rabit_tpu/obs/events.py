@@ -93,6 +93,18 @@ KINDS: dict[str, str] = {
     "bootstrap_blob": "tracker cached a spare bootstrap blob: version, nbytes",
     "epoch_changed": "worker adopted a new world epoch: epoch, world",
     "shard_rebalanced": "shard-rebalance callbacks ran for a resize",
+    # partial (quorum) allreduce (rabit_tpu/quorum,
+    # doc/partial_allreduce.md)
+    "quorum_policy": "quorum policy resolved at init: spec, wait_sec, "
+                     "flag_after",
+    "quorum_met": "round decided with exclusions: epoch, version, k, "
+                  "world, n_have, excluded",
+    "contribution_late": "an excluded round's block was delivered: "
+                         "src_version, rank",
+    "correction_folded": "a late block folded into a later round: "
+                         "version, src_version, rank",
+    "correction_dropped": "epoch boundary dropped an undelivered "
+                          "correction: src_version, rank, world",
     # collective schedules (rabit_tpu/sched, doc/scheduling.md)
     "schedule_planned": "tracker planned a wave's schedule: epoch, algo, "
                         "ring_order, n_avoided",

@@ -316,6 +316,8 @@ _TRACKER_INSTANTS = {
     "spare_parked", "spare_dropped", "spare_promoted",
     "world_shrunk", "world_grown", "bootstrap_blob",
     "schedule_planned", "schedule_repaired", "link_degraded",
+    "quorum_met", "contribution_late", "correction_folded",
+    "correction_dropped",
 }
 
 

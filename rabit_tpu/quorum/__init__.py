@@ -1,0 +1,53 @@
+"""rabit_tpu.quorum — straggler-tolerant K-of-N partial allreduce
+(ISSUE 8 tentpole; doc/partial_allreduce.md).
+
+rabit's lockstep collectives make every round as slow as the slowest
+worker; PR 3's straggler analytics measured exactly that.  Quorum mode
+spends the measurement: a collective round completes once **K of N**
+contributions have folded, the stragglers' late blocks land as
+**correction terms** at the next round boundary after delivery, and a
+per-round **exclusion record** agreed through the tracker keeps every
+rank's fold — and any replay after recovery — bitwise identical.
+
+Three pieces:
+
+* **policy** — the ``rabit_quorum`` spec math (fraction or count -> K
+  per world size), pure and elastic-aware;
+* **table** — the tracker-side ledger: decide-once exclusion records,
+  the outstanding-correction ledger (dropped with evidence at epoch
+  boundaries), late-delivery events, and exclusion streaks feeding the
+  PR 7 degraded-link avoid-set machinery;
+* the **executor** lives in :mod:`rabit_tpu.elastic.client`: tagged
+  block frames flooding a skip-augmented ring (a successor past the
+  quorum deadline dials around its silent predecessor — MAGIC_SKIP —
+  and the upstream rank tees the flow past the straggler), one
+  ``CMD_QUORUM`` agreement RPC per round, rank-order folds.
+
+The engines (native/xla) keep their exact collectives: quorum is a
+control-plane contract between the tracker and schedule-aware
+executors, exactly like the PR 7 planned rings.  ``rabit_quorum=""``
+(default) or ``"1.0"`` never excludes — results are bitwise identical
+to the legacy exact path.
+"""
+
+from rabit_tpu.quorum.policy import (  # noqa: F401 (re-exports)
+    parse_spec,
+    quorum_count,
+)
+from rabit_tpu.quorum.table import QuorumTable  # noqa: F401
+
+
+def resolve(cfg) -> dict:
+    """Resolve the quorum config keys (doc/parameters.md, "Partial
+    (quorum) allreduce") into the tracker/worker-facing knobs.  Raises
+    ValueError on a malformed ``rabit_quorum`` — a typo'd quorum must
+    not silently run exact."""
+    spec = (cfg.get("rabit_quorum", "") or "").strip()
+    if spec:
+        parse_spec(spec)
+    return {
+        "quorum": spec,
+        "wait_sec": float(
+            cfg.get("rabit_quorum_wait_sec", "0.35") or "0.35"),
+        "flag_after": cfg.get_int("rabit_quorum_flag_after", 3),
+    }

@@ -1,0 +1,59 @@
+"""Quorum policy — how many contributions make a round (pure math).
+
+The ``rabit_quorum`` spec is either a fraction in ``(0, 1]`` (``"0.75"``
+means three quarters of the current world, ``"1.0"`` means everyone —
+the quorum machinery runs but never excludes) or an integer count
+(``"6"`` means six ranks, clamped into ``[1, world]``).  An integer
+literal is always a COUNT: ``"1"`` is a one-rank quorum, ``"1.0"`` is
+all of them.  The empty spec disables quorum mode entirely — the legacy
+exact collective, byte for byte.
+
+Fractions resolve against the CURRENT world size, so an elastic shrink
+or grow re-derives K at every wave without re-configuration.
+"""
+
+from __future__ import annotations
+
+import math
+
+
+def parse_spec(spec: str) -> tuple[str, float]:
+    """Validate a ``rabit_quorum`` spec; returns ("frac", f) or
+    ("count", n).  Raises ValueError on anything else — a typo'd quorum
+    must fail loudly at init, not silently run exact (or worse, K=1)."""
+    spec = (spec or "").strip()
+    if not spec:
+        raise ValueError("empty quorum spec (use '' to disable quorum mode)")
+    try:
+        n = int(spec)
+    except ValueError:
+        pass
+    else:
+        if n < 1:
+            raise ValueError(f"rabit_quorum count must be >= 1, got {n}")
+        return ("count", float(n))
+    try:
+        f = float(spec)
+    except ValueError:
+        raise ValueError(f"rabit_quorum={spec!r} is neither a count nor a "
+                         f"fraction")
+    if not 0.0 < f <= 1.0:
+        raise ValueError(f"rabit_quorum fraction must be in (0, 1], "
+                         f"got {f}")
+    return ("frac", f)
+
+
+def quorum_count(world: int, spec: str) -> int:
+    """K for one world size: the number of contributions that completes a
+    round.  Empty spec (quorum off) and ``"1.0"`` both resolve to the
+    full world; counts clamp into ``[1, world]``."""
+    world = int(world)
+    if world < 1:
+        raise ValueError(f"world must be >= 1, got {world}")
+    spec = (spec or "").strip()
+    if not spec:
+        return world
+    kind, value = parse_spec(spec)
+    if kind == "count":
+        return max(1, min(world, int(value)))
+    return max(1, min(world, math.ceil(value * world)))

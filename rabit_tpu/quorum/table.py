@@ -1,0 +1,133 @@
+"""Tracker-side quorum agreement — the per-round exclusion-record ledger.
+
+Every quorum round needs ONE answer to "which K contributions does this
+round fold?", identical on every rank, or the folds diverge bitwise.
+The tracker is the natural single decision point (it already owns rank
+assignment and the membership epoch line): workers report what they hold
+(``CMD_QUORUM``) and the FIRST report meeting the K-of-N quorum freezes
+the round's record ``(epoch, version) -> (excluded_ranks,
+corrections)``.  Every later report — including the excluded straggler's
+own, arriving rounds late — is answered with the same frozen record, so
+replay after recovery re-reads the same exclusions.
+
+The table is pure bookkeeping (no sockets, no clock): the tracker calls
+it under its own lock and emits the returned event dicts into the
+telemetry timeline.  Three ledgers ride along:
+
+* **outstanding** — ``(src_version, rank) -> world`` contributions a
+  record excluded that have not yet folded as corrections; a later
+  record's deciding report that holds them folds them
+  (``correction_folded``), an epoch change drops them
+  (``correction_dropped`` — corrections do not survive a membership
+  wave: a shrunk rank is excluded permanently, not buffered);
+* **late evidence** — the first report that *holds* an outstanding late
+  block emits ``contribution_late`` (the straggler delivered);
+* **streaks** — consecutive exclusions per rank; a rank late
+  ``flag_after`` rounds in a row is handed back to the tracker so its
+  incoming planned-ring link feeds the SAME avoid-set machinery as a
+  slow link (doc/scheduling.md repair) and the next wave's plan moves
+  the straggler off the ring hot path.
+"""
+
+from __future__ import annotations
+
+from rabit_tpu.quorum.policy import parse_spec, quorum_count
+
+
+class QuorumTable:
+    """One job's quorum ledger (see module docstring).  NOT thread-safe:
+    the tracker serializes access under its own lock."""
+
+    def __init__(self, spec: str, flag_after: int = 3):
+        parse_spec(spec)  # fail loudly at construction on a typo'd spec
+        self.spec = str(spec)
+        self.flag_after = max(int(flag_after), 0)
+        #: (epoch, version) -> frozen record dict (the CMD_QUORUM reply)
+        self._records: dict[tuple[int, int], dict] = {}
+        #: (src_version, rank) -> world size the exclusion happened at
+        self._outstanding: dict[tuple[int, int], int] = {}
+        self._late_seen: set[tuple[int, int]] = set()
+        self._streak: dict[int, int] = {}
+
+    # -- reporting ---------------------------------------------------------
+
+    def report(self, epoch: int, version: int, world: int,
+               have: list[int], held: list) -> tuple[dict, list[dict],
+                                                     list[int]]:
+        """Fold one worker report in; returns ``(reply, events,
+        flag_ranks)``.  ``reply`` is the frozen record (or an undecided
+        placeholder), ``events`` are telemetry event dicts (sans ``ts``),
+        ``flag_ranks`` are ranks whose exclusion streak just hit
+        ``flag_after`` (feed them to the schedule repair avoid set)."""
+        events: list[dict] = []
+        flags: list[int] = []
+        held_t = sorted({(int(sv), int(r)) for sv, r in held})
+        for t in held_t:
+            if t in self._outstanding and t not in self._late_seen:
+                self._late_seen.add(t)
+                events.append({"kind": "contribution_late", "epoch": epoch,
+                               "version": version, "src_version": t[0],
+                               "rank": t[1]})
+        key = (int(epoch), int(version))
+        rec = self._records.get(key)
+        if rec is None:
+            have_set = {int(r) for r in have if 0 <= int(r) < world}
+            k = quorum_count(world, self.spec)
+            if len(have_set) < k:
+                return ({"decided": False, "k": k, "version": version},
+                        events, flags)
+            held_ok = [t for t in held_t if t in self._outstanding]
+            excluded = sorted(set(range(world)) - have_set)
+            rec = {"decided": True, "epoch": int(epoch),
+                   "version": int(version), "k": k,
+                   "excluded": excluded,
+                   "corrections": [list(t) for t in held_ok]}
+            self._records[key] = rec
+            for t in held_ok:
+                del self._outstanding[t]
+            for r in excluded:
+                self._outstanding[(int(version), r)] = int(world)
+            if excluded:
+                events.append({"kind": "quorum_met", "epoch": epoch,
+                               "version": version, "k": k, "world": world,
+                               "n_have": len(have_set),
+                               "excluded": excluded})
+            for sv, r in held_ok:
+                events.append({"kind": "correction_folded", "epoch": epoch,
+                               "version": version, "src_version": sv,
+                               "rank": r})
+            for r in range(world):
+                if r in rec["excluded"]:
+                    streak = self._streak.get(r, 0) + 1
+                    self._streak[r] = streak
+                    if self.flag_after and streak == self.flag_after:
+                        flags.append(r)
+                else:
+                    self._streak[r] = 0
+        return rec, events, flags
+
+    # -- membership boundaries ---------------------------------------------
+
+    def epoch_changed(self, epoch: int) -> list[tuple[int, int, int]]:
+        """A membership wave committed ``epoch``: corrections do not
+        survive the boundary (ranks renumber, shards re-cut, a shrunk
+        rank is gone for good), so the outstanding ledger settles by
+        dropping.  Returns ``[(src_version, rank, world), ...]`` for the
+        tracker's ``correction_dropped`` evidence; records of older
+        epochs are pruned so a redone round gets a fresh decision."""
+        dropped = sorted((sv, r, w)
+                         for (sv, r), w in self._outstanding.items())
+        self._outstanding.clear()
+        self._late_seen.clear()
+        self._streak.clear()
+        self._records = {k: r for k, r in self._records.items()
+                         if k[0] >= int(epoch)}
+        return dropped
+
+    # -- introspection -----------------------------------------------------
+
+    def outstanding(self) -> list[tuple[int, int, int]]:
+        """Undelivered exclusions as ``(src_version, rank, world)`` —
+        telemetry surfaces these so accounting (chaos closed-form
+        adjustment, operators) can quantify the missing mass exactly."""
+        return sorted((sv, r, w) for (sv, r), w in self._outstanding.items())

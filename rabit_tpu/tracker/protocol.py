@@ -14,7 +14,7 @@ worker -> tracker (fresh connection per message):
     u32 MAGIC_HELLO
     u32 cmd          (CMD_START | CMD_RECOVER | CMD_PRINT | CMD_SHUTDOWN
                       | CMD_METRICS | CMD_HEARTBEAT | CMD_SPARE
-                      | CMD_EPOCH | CMD_BLOB)
+                      | CMD_EPOCH | CMD_BLOB | CMD_QUORUM)
     i32 prev_rank    (-1 if never assigned; stable re-admission key is task_id)
     str task_id
     if start/recover/spare: u32 listen_port (worker binds BEFORE contacting
@@ -33,6 +33,15 @@ worker -> tracker (fresh connection per message):
     if blob:          u32 version, u32 nbytes, bytes — the current global
                       model, already codec-compressed by the sender; the
                       tracker caches the newest as the spare bootstrap blob
+    if quorum:        str json — one quorum-round report, ``{"epoch": E,
+                      "v": V, "have": [ranks...], "held": [[src_v, rank]
+                      ...]}`` (doc/partial_allreduce.md): the ranks whose
+                      version-V blocks this worker holds plus the late
+                      blocks from earlier excluded rounds it can fold as
+                      corrections.  The tracker decides each round's
+                      exclusion record exactly ONCE (first report meeting
+                      the K-of-N quorum wins) so every rank folds the
+                      same K contributions
 
 tracker -> worker (start/recover reply, sent when the wave of world_size
 workers is complete):
@@ -73,6 +82,12 @@ tracker -> worker (epoch reply): u32 ACK, str json — ``{"epoch": E,
     "world": W, "rewave": bool}``; rewave asks the worker to re-enter a
     wave at this version boundary (grow-back pending)
 
+tracker -> worker (quorum reply): u32 ACK, str json — the round's
+    exclusion record ``{"decided": true, "epoch": E, "version": V,
+    "k": K, "excluded": [ranks...], "corrections": [[src_v, rank]...]}``
+    once decided, else ``{"decided": false, ...}`` (the worker keeps
+    pumping blocks and re-reports until the record lands)
+
 tracker -> worker (metrics/heartbeat reply): u32 ACK, str server_ts — the
     tracker's ``time.time()`` stamped while answering.  The worker brackets
     the RPC and takes the NTP-style midpoint: ``offset = server_ts -
@@ -83,6 +98,16 @@ tracker -> worker (metrics/heartbeat reply): u32 ACK, str server_ts — the
 
 worker <-> worker link handshake (both directions on connect/accept):
     u32 MAGIC_LINK, i32 my_rank, u32 epoch
+
+worker -> worker skip handshake (quorum mode, doc/partial_allreduce.md):
+    u32 MAGIC_SKIP, i32 my_rank, u32 epoch, u32 version — a ring
+    successor past the quorum deadline dials AROUND its silent
+    predecessor to the next live upstream rank; the acceptor registers
+    the socket as a tee (every tagged block it holds or later sees is
+    duplicated onto it) so the flow of contributions routes around the
+    straggler.  Tagged blocks ride inside the ordinary length-framed
+    link protocol as ``put_block_frame`` payloads: u32 version,
+    i32 origin_rank, raw encoded bytes.
 """
 
 from __future__ import annotations
@@ -97,6 +122,7 @@ MAGIC_HELLO = 0x7AB17001
 MAGIC_ASSIGN = 0x7AB17002
 MAGIC_LINK = 0x7AB17003
 MAGIC_BLOB = 0x7AB17004
+MAGIC_SKIP = 0x7AB17005
 ACK = 0
 
 CMD_START = 1
@@ -108,6 +134,7 @@ CMD_HEARTBEAT = 6
 CMD_SPARE = 7
 CMD_EPOCH = 8
 CMD_BLOB = 9
+CMD_QUORUM = 10
 
 #: How many renewal intervals a lease survives without a renewal.  2 means
 #: one lost/late heartbeat is tolerated; the second expires the lease, so a
@@ -253,7 +280,8 @@ def send_hello(
     out = [put_u32(MAGIC_HELLO), put_u32(cmd), put_i32(prev_rank), put_str(task_id)]
     if cmd in (CMD_START, CMD_RECOVER, CMD_SPARE):
         out.append(put_u32(listen_port))
-    elif cmd in (CMD_PRINT, CMD_METRICS, CMD_HEARTBEAT, CMD_EPOCH):
+    elif cmd in (CMD_PRINT, CMD_METRICS, CMD_HEARTBEAT, CMD_EPOCH,
+                 CMD_QUORUM):
         out.append(put_str(message))
     elif cmd == CMD_BLOB:
         out += [put_u32(blob_version), put_u32(len(blob)), blob]
@@ -282,6 +310,44 @@ def read_sched_frame(sock) -> tuple[str, list[int]]:
     algo = get_str(sock)
     ring_order = [get_i32(sock) for _ in range(get_u32(sock))]
     return algo, ring_order
+
+
+def put_block_frame(version: int, origin: int, payload: bytes) -> bytes:
+    """Tag one quorum-mode block: ``(version, origin_rank, payload)``.
+    The tagged bytes ride INSIDE the ordinary length-framed link protocol
+    (doc/partial_allreduce.md) — tagging is what lets a late contribution
+    from an excluded round be recognized as a correction term, and what
+    makes duplicate delivery over a skip tee idempotent."""
+    return _U32.pack(version) + _I32.pack(origin) + payload
+
+
+def read_block_frame(data: bytes) -> tuple[int, int, bytes]:
+    """Parse one tagged block payload; returns (version, origin, bytes).
+    Raises ValueError on anything too short to carry the tag (a torn or
+    foreign frame from a stale-epoch writer)."""
+    if len(data) < 8:
+        raise ValueError(f"short block frame ({len(data)} bytes)")
+    version = _U32.unpack_from(data, 0)[0]
+    origin = _I32.unpack_from(data, 4)[0]
+    return version, origin, data[8:]
+
+
+def put_skip_frame(rank: int, epoch: int, version: int) -> bytes:
+    """The quorum skip handshake a ring successor dials AROUND a silent
+    predecessor with (MAGIC_SKIP + dialer rank + epoch + the round it is
+    stuck on).  The acceptor validates the epoch, replays every tagged
+    block it retains, and tees all later blocks onto the socket."""
+    return b"".join([put_u32(MAGIC_SKIP), put_i32(rank), put_u32(epoch),
+                     put_u32(version)])
+
+
+def read_skip_frame(sock) -> tuple[int, int, int]:
+    """Read the skip-handshake fields AFTER the dispatching caller
+    consumed MAGIC_SKIP; returns (dialer_rank, epoch, version)."""
+    rank = get_i32(sock)
+    epoch = get_u32(sock)
+    version = get_u32(sock)
+    return rank, epoch, version
 
 
 def recv_blob_frame(sock) -> tuple[int, bytes]:
@@ -364,8 +430,9 @@ def tracker_rpc(
     doesn't stampede the tracker); when the budget is exhausted the last
     error surfaces as :class:`TrackerUnreachable`.
 
-    Returns the :class:`Assignment` for START/RECOVER, the parsed epoch
-    dict (``{"epoch", "world", "rewave"}``) for EPOCH, the u32 ACK value
+    Returns the :class:`Assignment` for START/RECOVER, the parsed reply
+    dict for EPOCH (``{"epoch", "world", "rewave"}``) and QUORUM (the
+    round's exclusion record, or ``{"decided": false}``), the u32 ACK value
     otherwise — as a :class:`TimedAck` (ACK plus the tracker's clock stamp
     and the local send/recv bracket) for METRICS/HEARTBEAT.  Retrying
     START/RECOVER is safe: the tracker replaces a task id's stale pending
@@ -393,7 +460,7 @@ def tracker_rpc(
                     # plus the local send/recv bracket is one clock sample
                     server_ts = float(get_str(sock))
                     return TimedAck(ack, server_ts, t_send, time.time())
-                if cmd == CMD_EPOCH:
+                if cmd in (CMD_EPOCH, CMD_QUORUM):
                     import json as _json
 
                     return _json.loads(get_str(sock))

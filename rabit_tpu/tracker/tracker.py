@@ -39,6 +39,20 @@ back (``world_grown``) when spares return, at the next version boundary
 (workers poll ``CMD_EPOCH`` between checkpoints and re-enter a wave when
 the reply carries ``rewave``).
 
+Quorum collectives (doc/partial_allreduce.md): with ``quorum=`` set the
+tracker owns the per-round **exclusion record** — ``CMD_QUORUM`` reports
+name the blocks a rank holds, the first report meeting the K-of-N quorum
+freezes ``(epoch, version) -> (excluded_ranks, corrections)``, and every
+rank (including the excluded straggler, arriving rounds late) folds the
+same frozen record, so quorum folds and post-recovery replay stay
+bitwise deterministic.  Late blocks fold as corrections at the next
+record after delivery (``contribution_late``/``correction_folded``); an
+epoch boundary settles undelivered corrections by dropping them with
+``correction_dropped`` evidence (a shrunk rank is excluded permanently,
+not buffered); a rank excluded ``quorum_flag_after`` rounds in a row
+feeds the SAME degraded-link avoid-set machinery as a slow link, so the
+next plan moves the persistent straggler off the ring hot path.
+
 Collective schedules (doc/scheduling.md): every wave is planned by
 ``rabit_tpu.sched`` — ``rabit_schedule=auto|tree|ring|swing`` picks the
 ring layout over the mesh model, and worker ``slow_link`` reports
@@ -64,6 +78,7 @@ from typing import Callable
 from rabit_tpu import sched
 from rabit_tpu.elastic.membership import CLOSE, MembershipManager
 from rabit_tpu.obs.events import event_from_stats_line
+from rabit_tpu.quorum import QuorumTable
 from rabit_tpu.tracker import protocol as P
 
 #: telemetry.json envelope version (bump on incompatible change).
@@ -189,7 +204,9 @@ class Tracker:
                  schedule: str = "auto",
                  sched_mesh: str = "",
                  sched_repair: bool = True,
-                 sched_wait_share: float = 0.25):
+                 sched_wait_share: float = 0.25,
+                 quorum: str = "",
+                 quorum_flag_after: int = 3):
         #: CURRENT world size — mutable under elastic membership (shrink/
         #: grow); ``base_world`` is the launch size and grow-back target.
         self.world_size = world_size
@@ -250,6 +267,14 @@ class Tracker:
         self.sched_wait_share = float(sched_wait_share)
         self._link_flags: set[tuple[str, str]] = set()  # (src_task, dst_task)
         self._repair_wanted = False
+        # Quorum collectives (rabit_tpu/quorum, doc/partial_allreduce.md):
+        # the per-round exclusion-record ledger, or None when quorum mode
+        # is off.  _last_ring remembers the most recent planned ring order
+        # so a persistent straggler's INCOMING link can be flagged into
+        # the repair avoid set.
+        self._quorum = (QuorumTable(quorum, flag_after=quorum_flag_after)
+                        if quorum else None)
+        self._last_ring: list[int] = []
         self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._srv.bind((host, port))
@@ -395,6 +420,13 @@ class Tracker:
                         "version": version, "nbytes": nbytes,
                     })
                 conn.sendall(P.put_u32(P.ACK))
+            elif cmd == P.CMD_QUORUM:
+                # One quorum-round report (doc/partial_allreduce.md): the
+                # reply is the round's frozen exclusion record, or an
+                # undecided placeholder the worker re-polls past.
+                msg = P.get_str(conn)
+                reply = self._quorum_report(msg)
+                conn.sendall(P.put_u32(P.ACK) + P.put_str(json.dumps(reply)))
             elif cmd == P.CMD_PRINT:
                 msg = P.get_str(conn)
                 self.messages.append(msg)
@@ -516,6 +548,55 @@ class Tracker:
         if not self.quiet:
             print(f"[tracker] spare {task_id} parked "
                   f"(blob v{version}, pool {len(self._spares)})", flush=True)
+
+    # -- quorum agreement --------------------------------------------------
+
+    def _quorum_report(self, payload: str) -> dict:
+        """Fold one CMD_QUORUM report into the quorum table (decide-once
+        exclusion records; doc/partial_allreduce.md).  Emits the table's
+        telemetry events and feeds persistent-straggler flags into the
+        schedule-repair avoid set OUTSIDE the lock (flag_link locks)."""
+        try:
+            req = json.loads(payload)
+            epoch = int(req["epoch"])
+            version = int(req["v"])
+            have = [int(r) for r in req.get("have", ())]
+            held = [(int(sv), int(r)) for sv, r in req.get("held", ())]
+        except (ValueError, TypeError, KeyError):
+            return {"decided": False, "error": "malformed report"}
+        late_links: list[tuple[int, int]] = []
+        with self._lock:
+            if self._quorum is None:
+                return {"decided": False, "disabled": True}
+            if epoch != self.elastic.epoch:
+                # A worker a wave behind: its round will be redone under
+                # the new epoch — never decide against a stale world.
+                return {"decided": False, "stale_epoch": True}
+            rec, events, flag_ranks = self._quorum.report(
+                epoch, version, self.world_size, have, held)
+            ts = round(time.time(), 6)
+            for ev in events:
+                self.events.append({"ts": ts, **ev})
+            order = self._last_ring or list(range(self.world_size))
+            pos = {r: i for i, r in enumerate(order)}
+            for r in flag_ranks:
+                if r in pos and len(order) >= 2:
+                    late_links.append((order[(pos[r] - 1) % len(order)], r))
+        for src, dst in late_links:
+            # A rank late quorum_flag_after rounds in a row feeds the SAME
+            # avoid-set machinery as a slow link: the next wave's plan
+            # routes the ring around the persistent straggler.
+            with self._lock:
+                self.events.append({
+                    "ts": round(time.time(), 6), "kind": "link_degraded",
+                    "rank": dst, "src": src, "dst": dst, "via": "quorum",
+                })
+            if not self.quiet:
+                print(f"[tracker] rank {dst} persistently late under "
+                      f"quorum; flagging incoming link {src}->{dst} for "
+                      f"repair", flush=True)
+            self.flag_link(src, dst)
+        return rec
 
     # -- schedule planning -------------------------------------------------
 
@@ -666,6 +747,17 @@ class Tracker:
         wepoch, delta = self.elastic.commit(rank_map, world)
         self.world_size = world
         ts = round(time.time(), 6)
+        if self._quorum is not None:
+            # An epoch boundary settles the correction ledger by dropping
+            # (doc/partial_allreduce.md): ranks renumber and shards re-cut,
+            # so an undelivered late block from the old world can never
+            # fold — record exactly what went missing.
+            for sv, r, w in self._quorum.epoch_changed(wepoch.epoch):
+                self.events.append({
+                    "ts": ts, "kind": "correction_dropped",
+                    "epoch": wepoch.epoch, "src_version": sv, "rank": r,
+                    "world": w,
+                })
         restarted = []
         for p in members:
             if p.cmd == P.CMD_START:
@@ -750,6 +842,8 @@ class Tracker:
         splan = self._plan_schedule(world, plan["rank_map"])
         ts = round(time.time(), 6)
         with self._lock:
+            self._last_ring = (list(splan.ring_order)
+                               or list(range(world)))
             self.events.append({
                 "ts": ts, "kind": "schedule_planned",
                 "epoch": plan["epoch"], "algo": splan.algo, "world": world,
@@ -924,6 +1018,8 @@ class Tracker:
             events = list(self.events)
             snapshots = {str(r): s for r, s in sorted(self.snapshots.items())}
             restarts = {t: n - 1 for t, n in self._n_starts.items() if n > 1}
+            q_outstanding = ([list(t) for t in self._quorum.outstanding()]
+                             if self._quorum is not None else [])
         waves = [e for e in events if e["kind"] == "wave"]
         # Per-rank clock-offset estimates (tracker_ts = worker_ts +
         # offset_s), shipped inside snapshots; the trace merger uses these
@@ -949,6 +1045,16 @@ class Tracker:
             "schedule": self.schedule,
             "n_schedule_repaired": sum(1 for e in events
                                        if e["kind"] == "schedule_repaired"),
+            "quorum": self._quorum.spec if self._quorum is not None else "",
+            "n_quorum_met": sum(1 for e in events
+                                if e["kind"] == "quorum_met"),
+            "n_corrections_folded": sum(1 for e in events
+                                        if e["kind"] == "correction_folded"),
+            "n_corrections_dropped": sum(
+                1 for e in events if e["kind"] == "correction_dropped"),
+            # still-undelivered exclusions at telemetry time, as
+            # [src_version, rank, world] — the exact missing mass
+            "quorum_outstanding": q_outstanding,
             "epochs": [{"epoch": we.epoch, "world": we.world_size}
                        for we in self.elastic.history],
             "restarts": restarts,

@@ -24,6 +24,11 @@ PR 7 adds the schedule surface (doc/scheduling.md):
   schedule run with repair off then on; the dst worker's cumulative
   link wait must drop once the ring routes around the degraded link.
 
+PR 8 adds ``--quorum-ablation`` (doc/partial_allreduce.md): live-rank
+rounds/sec with an injected compute straggler, quorum off vs on vs
+on+i8 — quorum off gates every round on the tail, quorum on must track
+the median worker (within 1.3x of the no-straggler baseline).
+
 Usage:  python tools/consensus_bench.py [--world 32] [--iters 200]
 Prints one JSON line per mode; the default latency mode runs as
 __main__ only (spawns a local cluster).
@@ -256,6 +261,71 @@ def slow_link_e2e(world: int = 3, delay: float = 0.12, niter: int = 8,
     }
 
 
+def quorum_ablation(world: int = 3, niter: int = 40,
+                    iter_sleep: float = 0.02,
+                    straggler_factor: float = 8.0,
+                    quorum: str = "0.6", seed: int = 2601) -> dict:
+    """The ISSUE 8 acceptance curve: live-rank rounds/sec with an
+    injected compute straggler (``straggler_factor`` x the per-round
+    sleep on one rank), quorum off vs on vs on+i8.
+
+    The compared metric is task 0's ROUND CADENCE (mean inter-commit
+    gap over the steady rounds), the honest "rounds/sec" of the live
+    ranks: quorum off gates every round on the straggler (cadence
+    tracks the tail), quorum on folds K-of-N and excludes it (cadence
+    tracks the median worker — the acceptance bar is within 1.3x of the
+    no-straggler baseline).  Job wall clocks ride along: the final
+    round is always exact, so completion still waits one straggler
+    delay.  Every arm's correctness (cross-rank bitwise identity,
+    quorum-adjusted closed form) is asserted inside
+    ``run_elastic_schedule``."""
+    from rabit_tpu.chaos import run_elastic_schedule
+
+    delay = straggler_factor * iter_sleep
+    strag = (world - 1, delay)
+
+    def arm(label: str, **kw) -> dict:
+        r = run_elastic_schedule(seed, world=world, schedule="ring",
+                                 niter=niter, iter_sleep=iter_sleep,
+                                 deadline_sec=120.0, **kw)
+        assert r.outcome == "completed", f"{label}: {r}"
+        return {
+            "elapsed_s": round(r.elapsed, 3),
+            "cadence_s": r.cadence_s,
+            "rounds_per_sec": round(1.0 / r.cadence_s, 2)
+            if r.cadence_s else 0.0,
+            "n_quorum_met": r.n_quorum_met,
+            "n_corrections_folded": r.n_corrections_folded,
+        }
+
+    arms = {
+        "base": arm("base"),
+        "straggler_off": arm("straggler_off", straggler=strag),
+        "straggler_on": arm("straggler_on", straggler=strag, quorum=quorum),
+        "straggler_on_i8": arm("straggler_on_i8", straggler=strag,
+                               quorum=quorum, codec="i8"),
+    }
+    base_c = arms["base"]["cadence_s"] or 1e-9
+    out = {
+        "bench": "quorum_ablation",
+        "world": world,
+        "niter": niter,
+        "iter_sleep_s": iter_sleep,
+        "straggler_factor": straggler_factor,
+        "straggler_rank": strag[0],
+        "quorum": quorum,
+        "arms": arms,
+        "off_cadence_vs_base": round(
+            arms["straggler_off"]["cadence_s"] / base_c, 2),
+        "on_cadence_vs_base": round(
+            arms["straggler_on"]["cadence_s"] / base_c, 2),
+        "on_i8_cadence_vs_base": round(
+            arms["straggler_on_i8"]["cadence_s"] / base_c, 2),
+    }
+    out["within_1_3x"] = out["on_cadence_vs_base"] <= 1.3
+    return out
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--world", type=int, default=32)
@@ -267,6 +337,13 @@ def main() -> None:
                     help="planner cost-model curve on a simulated mesh")
     ap.add_argument("--slow-link-e2e", action="store_true",
                     help="live chaos slow_link repair A/B")
+    ap.add_argument("--quorum-ablation", action="store_true",
+                    help="rounds/sec vs an injected straggler: quorum "
+                         "off/on/on+i8 (doc/partial_allreduce.md)")
+    ap.add_argument("--quorum", default="0.6",
+                    help="rabit_quorum spec for --quorum-ablation")
+    ap.add_argument("--quorum-niter", type=int, default=40)
+    ap.add_argument("--straggler-factor", type=float, default=8.0)
     ap.add_argument("--worlds", type=int, nargs="*",
                     default=[64, 128, 256, 384, 512],
                     help="worlds for --schedule-ablation")
@@ -284,6 +361,11 @@ def main() -> None:
         return
     if args.slow_link_e2e:
         print(json.dumps(slow_link_e2e()), flush=True)
+        return
+    if args.quorum_ablation:
+        print(json.dumps(quorum_ablation(
+            niter=args.quorum_niter, quorum=args.quorum,
+            straggler_factor=args.straggler_factor)), flush=True)
         return
     results = {}
     for on in (True, False):

@@ -12,9 +12,16 @@ ranks dump at finalize, then:
       straggler aggregates back into telemetry.json unless --no-fold.
 
   python tools/trace_tool.py report  OBS_DIR [--top K] [--json]
+                                     [--flag-links HOST:PORT]
       per-seqno arrival-skew analytics: top-K stragglers by cumulative
       lateness, worst collectives by first-enter vs last-enter skew,
-      recovery-affected collectives tallied separately.
+      recovery-affected collectives tallied separately.  --flag-links
+      closes the offline repair loop (doc/scheduling.md): the degraded
+      links the report implies (sched.links_from_stragglers over the
+      job's last planned ring) are pushed into the LIVE tracker at
+      HOST:PORT as slow_link reports, arming a repair replan at the
+      next epoch boundary — previously repair only triggered from
+      worker self-reports.
 
   python tools/trace_tool.py validate TRACE_JSON
       structural check of an exported trace against the trace_event
@@ -56,11 +63,51 @@ def cmd_export(args: argparse.Namespace) -> int:
     return 0
 
 
+def flag_links_from_report(report: dict, telemetry: dict, addr: str,
+                           wait_share: float = 0.5) -> list[tuple[int, int]]:
+    """Push a straggler report's implied degraded links into a live
+    tracker (the offline half of the repair loop; doc/scheduling.md).
+
+    The ring the lateness shares indict is the job's LAST planned order
+    (``schedule_planned`` events in telemetry; identity ring when the
+    job predates planning).  Each implied ``(src, dst)`` link rides the
+    SAME wire as a worker self-report — a ``slow_link`` print the
+    tracker ingests as a ``link_degraded`` event — so the avoid-set
+    machinery, the rewave arming, and the telemetry evidence are
+    byte-for-byte the live path's."""
+    from rabit_tpu import sched
+    from rabit_tpu.tracker import protocol as P
+
+    planned = [e for e in (telemetry.get("events") or [])
+               if e.get("kind") == "schedule_planned"]
+    if planned and planned[-1].get("ring_order"):
+        ring = [int(r) for r in planned[-1]["ring_order"]]
+    else:
+        ring = list(range(int(telemetry.get("world_size", 0) or 0)))
+    links = sorted(sched.links_from_stragglers(report, ring,
+                                               wait_share=wait_share))
+    host, _, port_s = addr.rpartition(":")
+    if not host:
+        raise ValueError(f"--flag-links wants HOST:PORT, got {addr!r}")
+    for src, dst in links:
+        line = (f"[{dst}] slow_link src={src} dst={dst} wait=0.0 "
+                f"share=1.0 origin=trace_tool")
+        P.tracker_rpc(host, int(port_s), P.CMD_PRINT, "trace_tool",
+                      message=line, timeout=5.0, retries=1)
+    return links
+
+
 def cmd_report(args: argparse.Namespace) -> int:
     job = trace.load_job(args.obs_dir)
     report = trace.straggler_report(job, top_k=args.top)
     if args.write_telemetry:
         trace.fold_into_telemetry(args.obs_dir, report)
+    if args.flag_links:
+        links = flag_links_from_report(report, job.telemetry or {},
+                                       args.flag_links,
+                                       wait_share=args.wait_share)
+        print(json.dumps({"flagged_links": [list(l) for l in links],
+                          "tracker": args.flag_links}))
     if args.json:
         print(json.dumps(report, indent=1, sort_keys=True))
         return 0
@@ -116,6 +163,11 @@ def main(argv: list[str] | None = None) -> int:
     rep.add_argument("--json", action="store_true")
     rep.add_argument("--write-telemetry", action="store_true",
                      help="fold the report into telemetry.json")
+    rep.add_argument("--flag-links", default="", metavar="HOST:PORT",
+                     help="push the report's implied degraded links into "
+                          "a live tracker (arms a repair replan)")
+    rep.add_argument("--wait-share", type=float, default=0.5,
+                     help="lateness-share threshold for --flag-links")
     rep.set_defaults(fn=cmd_report)
 
     val = sub.add_parser("validate", help="validate an exported trace")
