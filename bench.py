@@ -94,6 +94,15 @@ SCHED_CHILD_TIMEOUT = 120.0
 # like the other riders; RABIT_BENCH_QUORUM=0 skips it.
 QUORUM_BENCH = os.environ.get("RABIT_BENCH_QUORUM", "1") != "0"
 QUORUM_CHILD_TIMEOUT = 180.0
+# Control-plane scale sweep (ISSUE 9): simulated-world bootstrap/
+# recovery/liveness load against the thread-per-connection, reactor,
+# and relayed serving paths (tools/scale_sweep.py; doc/scaling.md) in a
+# CPU child.  The driver runs the SMALL worlds (the full 4096-8192 curve
+# is the durable RESULTS/scale_sweep.jsonl anchor); deducted from the
+# TPU budget like the other riders; RABIT_BENCH_SCALE=0 skips it.
+SCALE_BENCH = os.environ.get("RABIT_BENCH_SCALE", "1") != "0"
+SCALE_CHILD_TIMEOUT = 240.0
+SCALE_WORLDS = os.environ.get("RABIT_BENCH_SCALE_WORLDS", "512 1024")
 
 
 def log(msg):
@@ -448,6 +457,34 @@ def run_quorum_bench(timeout=QUORUM_CHILD_TIMEOUT):
     return lines
 
 
+def run_scale_bench(timeout=SCALE_CHILD_TIMEOUT):
+    """Scale-sweep records (tools/consensus_bench.py --scale-sweep) in a
+    child: simulated worlds, no real workers (sockets + one selector
+    loop; a child so a wedged arm cannot stall the driver).  Returns the
+    record list, empty on timeout/failure."""
+    cmd = [sys.executable,
+           os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "tools", "consensus_bench.py"),
+           "--scale-sweep", "--scale-worlds", *SCALE_WORLDS.split()]
+    lines = []
+    try:
+        r = subprocess.run(cmd, timeout=timeout, capture_output=True,
+                           text=True)
+        if r.returncode == 0:
+            for line in r.stdout.strip().splitlines():
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if isinstance(rec, dict) and rec.get("bench") == "scale_sweep":
+                    lines.append(rec)
+        else:
+            log(f"scale sweep child rc={r.returncode}")
+    except subprocess.TimeoutExpired:
+        log(f"scale sweep child timed out after {timeout:.0f}s")
+    return lines
+
+
 def probe_device(timeout=45.0) -> bool:
     """Fast TPU liveness check in a throwaway child: a wedged axon tunnel
     hangs at backend init (holding jax's lock forever), and burning the
@@ -620,6 +657,14 @@ def main():
                          min(tpu_budget, 300.0))
         log(f"quorum bench: {len(quorum_lines)} line(s); "
             f"TPU budget now {tpu_budget:.0f}s")
+    scale_lines = []
+    if SCALE_BENCH:
+        t_sw = time.time()
+        scale_lines = run_scale_bench()
+        tpu_budget = max(tpu_budget - (time.time() - t_sw),
+                         min(tpu_budget, 300.0))
+        log(f"scale sweep: {len(scale_lines)} line(s); "
+            f"TPU budget now {tpu_budget:.0f}s")
     res = try_tpu_within_budget(tpu_budget)
     n_rows = N_ROWS
     if not isinstance(res, dict):
@@ -651,6 +696,8 @@ def main():
             rec["schedule_ablation"] = sched_lines
         if quorum_lines:
             rec["quorum_ablation"] = quorum_lines
+        if scale_lines:
+            rec["scale_sweep"] = scale_lines
         print(json.dumps(rec), flush=True)
         return
     device_time = res["device_time"]
@@ -698,6 +745,8 @@ def main():
         rec["schedule_ablation"] = sched_lines
     if quorum_lines:
         rec["quorum_ablation"] = quorum_lines
+    if scale_lines:
+        rec["scale_sweep"] = scale_lines
     print(json.dumps(rec), flush=True)
 
 

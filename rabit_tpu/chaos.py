@@ -85,6 +85,17 @@ class FaultSpec:
     #: path).  A proxy with slow_link set is a dedicated link proxy: the
     #: sampled faults above do not apply to it.
     slow_link: tuple[int | None, float] | None = None
+    #: relay-tier faults (doc/scaling.md; consumed by
+    #: :func:`run_elastic_schedule` ``relays=`` mode, not by the proxy):
+    #: ``relay_death=(at_s, down_s)`` stops relay 0 ``at_s`` seconds into
+    #: the run and restarts it on the SAME port ``down_s`` later — the
+    #: relay-bounce shape (children reconnect; their padded upstream
+    #: leases must survive without a spurious lease_expired).
+    #: ``relay_partition=(at_s, dur_s)`` severs relay 0's upstream
+    #: channel for ``dur_s`` while it keeps serving children locally —
+    #: the split-coordination-tier shape (batches resume at heal).
+    relay_death: tuple[float, float] | None = None
+    relay_partition: tuple[float, float] | None = None
 
     def clear(self) -> "FaultSpec":
         return FaultSpec()
@@ -509,6 +520,12 @@ class ElasticScheduleResult:
     #: live-rank round cadence the quorum ablation compares (a straggler
     #: shows up here under quorum off, and must NOT under quorum on)
     cadence_s: float = 0.0
+    # relay-tier runs (rabit_tpu.relay, doc/scaling.md)
+    relays: int = 0                   # relay nodes interposed (0 = direct)
+    n_relay_lost: int = 0             # relay channel drops the tracker saw
+    n_batches_folded: int = 0         # non-empty CMD_BATCH envelopes folded
+    n_spurious_expired: int = 0       # lease_expired for tasks that never
+    #                                   died (must stay 0 across a bounce)
 
 
 def run_elastic_schedule(seed: int, world: int | None = None,
@@ -524,7 +541,11 @@ def run_elastic_schedule(seed: int, world: int | None = None,
                          quorum_flag_after: int = 0,
                          codec: str = "",
                          mix_faults: bool = False,
-                         iter_sleep: float | None = None) -> ElasticScheduleResult:
+                         iter_sleep: float | None = None,
+                         relays: int = 0,
+                         relay_fault: FaultSpec | None = None,
+                         relay_flush: float = 0.1,
+                         heartbeat_sec: float = 0.15) -> ElasticScheduleResult:
     """One fuzzed shrink/grow scenario (deterministic per seed).
 
     A seeded mix of elastic failure shapes against a real elastic tracker:
@@ -572,6 +593,18 @@ def run_elastic_schedule(seed: int, world: int | None = None,
     sampled kills/spares for a clean arm unless ``mix_faults=True`` (the
     straggler+quorum+kill campaigns); the sampled victim set never
     contains the straggler or task "0".
+
+    ``relays=R`` interposes R :class:`rabit_tpu.relay.Relay` nodes
+    between the workers and the tracker (workers shard round-robin, the
+    wire they speak is unchanged — doc/scaling.md).  ``relay_fault``
+    applies the :class:`FaultSpec` relay faults to relay 0:
+    ``relay_death`` bounces it (stop, wait, restart on the SAME port —
+    children retry and reconnect), ``relay_partition`` severs only its
+    upstream channel while children keep getting local ACKs.  Relay runs
+    additionally assert that NO task that stayed alive suffered a
+    ``lease_expired`` (the padded upstream lease must ride out a bounce)
+    and that a relay death never shows up as a membership event of its
+    children.
 
     Quorum correctness asserts: every completed worker's final state is
     BITWISE IDENTICAL; with a single epoch the state equals the closed
@@ -652,6 +685,72 @@ def run_elastic_schedule(seed: int, world: int | None = None,
                       quorum=quorum,
                       quorum_flag_after=quorum_flag_after).start()
     addr = (tracker.host, tracker.port)
+    # Relay tier (doc/scaling.md): workers shard round-robin across R
+    # in-process relays; relay 0 is the fault target.
+    relay_objs: list = []
+    relay_lock = threading.Lock()
+    if relays > 0:
+        from rabit_tpu.relay import Relay
+
+        relay_objs = [Relay(addr, relay_id=f"relay{i}",
+                            flush_sec=relay_flush, quiet=True).start()
+                      for i in range(int(relays))]
+
+    def task_addr(tid: str) -> tuple[str, int]:
+        if not relay_objs:
+            return addr
+        try:
+            idx = int(tid.lstrip("s"))
+        except ValueError:
+            idx = sum(tid.encode())
+        with relay_lock:
+            r = relay_objs[idx % len(relay_objs)]
+        return (r.host, r.port)
+
+    stop_fault = threading.Event()
+    fault_threads: list[threading.Thread] = []
+    if relay_objs and relay_fault is not None:
+        from rabit_tpu.relay import Relay
+
+        def bounce_relay() -> None:
+            at_s, down_s = relay_fault.relay_death
+            if stop_fault.wait(at_s):
+                return
+            with relay_lock:
+                old = relay_objs[0]
+            port = old.port
+            old.stop()
+            if stop_fault.wait(down_s):
+                return
+            for _ in range(30):  # the freed port can lag a beat
+                try:
+                    fresh = Relay(addr, relay_id="relay0", port=port,
+                                  flush_sec=relay_flush, quiet=True).start()
+                    break
+                except OSError:
+                    if stop_fault.wait(0.1):
+                        return
+            else:
+                return
+            with relay_lock:
+                relay_objs[0] = fresh
+
+        def partition_relay() -> None:
+            at_s, dur_s = relay_fault.relay_partition
+            if stop_fault.wait(at_s):
+                return
+            with relay_lock:
+                r0 = relay_objs[0]
+            r0.set_partition(True)
+            stop_fault.wait(dur_s)
+            r0.set_partition(False)
+
+        if relay_fault.relay_death is not None:
+            fault_threads.append(threading.Thread(target=bounce_relay,
+                                                  daemon=True))
+        if relay_fault.relay_partition is not None:
+            fault_threads.append(threading.Thread(target=partition_relay,
+                                                  daemon=True))
     t0 = time.monotonic()
     results: dict[str, object] = {}
     lock = threading.Lock()
@@ -672,8 +771,8 @@ def run_elastic_schedule(seed: int, world: int | None = None,
         link_to = 1.0 if slow_link is None else max(1.0, 4 * slow_link[2])
         if straggler is not None:
             link_to = max(link_to, 4 * s_delay)
-        w = ElasticWorker(addr, tid, contribution, niter,
-                          heartbeat_sec=0.15, rpc_timeout=2.0,
+        w = ElasticWorker(task_addr(tid), tid, contribution, niter,
+                          heartbeat_sec=heartbeat_sec, rpc_timeout=2.0,
                           wave_timeout=10.0, link_timeout=link_to,
                           deadline_sec=deadline_sec, fail=fail,
                           quorum=quorum, quorum_wait=quorum_wait,
@@ -706,8 +805,9 @@ def run_elastic_schedule(seed: int, world: int | None = None,
         time.sleep(delay)
         if time.monotonic() - t0 > deadline_sec:
             return
-        w = ElasticWorker(addr, tid, contribution, niter, spare=True,
-                          heartbeat_sec=0.15, rpc_timeout=2.0,
+        w = ElasticWorker(task_addr(tid), tid, contribution, niter,
+                          spare=True,
+                          heartbeat_sec=heartbeat_sec, rpc_timeout=2.0,
                           wave_timeout=10.0, link_timeout=1.0,
                           deadline_sec=max(deadline_sec
                                            - (time.monotonic() - t0), 1.0),
@@ -721,7 +821,7 @@ def run_elastic_schedule(seed: int, world: int | None = None,
                                       args=(tid, delay, fail), daemon=True)
                      for tid, delay, fail in spare_specs]
     try:
-        for th in threads + spare_threads:
+        for th in threads + spare_threads + fault_threads:
             th.start()
         for th in threads:
             th.join(timeout=deadline_sec + 10.0 - (time.monotonic() - t0))
@@ -730,6 +830,7 @@ def run_elastic_schedule(seed: int, world: int | None = None,
                     f"elastic schedule seed={seed}: worker thread hung past "
                     f"the schedule deadline ({deadline_sec}s)")
     finally:
+        stop_fault.set()
         # Primaries are done (or the schedule failed): release the pool —
         # stop() closes the warm sockets, so spares that were never
         # promoted exit their park loop instead of waiting out their
@@ -738,6 +839,14 @@ def run_elastic_schedule(seed: int, world: int | None = None,
         tracker.stop()
         if link_proxy is not None:
             link_proxy.stop()
+        # Join the fault threads BEFORE stopping relays: a bounce thread
+        # mid-restart could otherwise install a fresh relay after the
+        # stop loop ran and leak it.
+        for th in fault_threads:
+            th.join(timeout=8.0)
+        with relay_lock:
+            for r in relay_objs:
+                r.stop()
         # A promoted spare mid-recovery would otherwise spin its bounded
         # re-check-in loop against the stopped tracker until its own
         # deadline — stop() flips it to a fast, clean exit.
@@ -844,6 +953,18 @@ def run_elastic_schedule(seed: int, world: int | None = None,
                 f"seed={seed}: wave epoch {e['epoch']} ranks {ranks} not "
                 f"dense for world {e['world']}")
     worlds_seen = sorted({e["world"] for e in waves})
+    # -- relay-tier sanity: a relay bounce/partition is NOT a membership
+    # event of its children — no task that stayed alive may have had its
+    # lease expired (the padded upstream lease must cover the gap).
+    died_tasks = {tid for tid, r in results.items()
+                  if getattr(r, "died", False)}
+    expired_tasks = {e.get("task_id") for e in tracker.events
+                     if e["kind"] == "lease_expired"}
+    spurious = expired_tasks - died_tasks - set(kill_at)
+    if relays and spurious:
+        raise AssertionError(
+            f"seed={seed}: spurious lease_expired for live tasks "
+            f"{sorted(spurious)} (relay bounce must not kill children)")
     dst_res = results.get(str(slow_link[1])) if slow_link is not None else None
     cadence = 0.0
     ct = getattr(results.get("0"), "commit_times", None) or {}
@@ -871,4 +992,10 @@ def run_elastic_schedule(seed: int, world: int | None = None,
         n_corrections_dropped=sum(1 for e in tracker.events
                                   if e["kind"] == "correction_dropped"),
         cadence_s=round(cadence, 6),
+        relays=int(relays),
+        n_relay_lost=sum(1 for e in tracker.events
+                         if e["kind"] == "relay_lost"),
+        n_batches_folded=sum(1 for e in tracker.events
+                             if e["kind"] == "batch_folded"),
+        n_spurious_expired=len(spurious),
     )

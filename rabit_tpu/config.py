@@ -162,6 +162,13 @@ DEFAULTS: dict[str, str] = {
     # against the tracker before the final snapshot ships it.
     "rabit_trace_exit": "0",
     "rabit_trace_clock_pings": "2",
+    # Serving at scale (doc/scaling.md).  rabit_tracker_backlog: the
+    # tracker's listen(2) backlog — a bootstrap wave is world_size nearly
+    # simultaneous connects, and a short backlog turns the overflow into
+    # 1s+ SYN-retransmit latency; raise toward the world size for
+    # O(10^3)+ direct worlds (relayed deployments keep the root's accept
+    # count at O(relays) instead).
+    "rabit_tracker_backlog": "1024",
     # Default ON, matching the native engine (see comm.cc Configure): with
     # Nagle on, every cold-direction header write stalls ~40ms behind the
     # peer's delayed ACK — measured 44ms/op on loopback object broadcasts.

@@ -105,6 +105,16 @@ KINDS: dict[str, str] = {
                          "version, src_version, rank",
     "correction_dropped": "epoch boundary dropped an undelivered "
                           "correction: src_version, rank, world",
+    # serving at scale (reactor + relay tier; rabit_tpu/relay,
+    # doc/scaling.md)
+    "relay_up": "a relay's persistent CMD_BATCH channel registered: "
+                "relay, host",
+    "relay_lost": "a relay channel died (stateless fan-in: children "
+                  "reconnect): relay",
+    "batch_folded": "one coalesced relay envelope folded: relay, n "
+                    "sub-messages",
+    "messages_dropped": "the bounded worker-print log overflowed: cap "
+                        "(total drops in telemetry.json)",
     # collective schedules (rabit_tpu/sched, doc/scheduling.md)
     "schedule_planned": "tracker planned a wave's schedule: epoch, algo, "
                         "ring_order, n_avoided",

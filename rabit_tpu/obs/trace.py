@@ -318,6 +318,7 @@ _TRACKER_INSTANTS = {
     "schedule_planned", "schedule_repaired", "link_degraded",
     "quorum_met", "contribution_late", "correction_folded",
     "correction_dropped",
+    "relay_up", "relay_lost", "batch_folded", "messages_dropped",
 }
 
 

@@ -1,0 +1,608 @@
+"""Hierarchical relay tier — constant fan-out coordination at pod scale.
+
+At O(10^3)-O(10^4) workers the root tracker's ceiling is not the data
+plane but its own accept path: every heartbeat, metrics snapshot, and
+epoch poll is a fresh TCP connection, and a bootstrap wave is an O(N)
+accept storm (doc/scaling.md; PAPERS.md "Highly Available Data Parallel
+ML training on Mesh Networks" makes the same argument — the coordination
+tier must be hierarchical and constant-fan-out or it sets job startup
+and recovery latency).
+
+A :class:`Relay` is a STATELESS fan-in node speaking the ordinary
+tracker wire to its children (workers point ``DMLC_TRACKER_URI`` at it —
+zero worker changes) and ONE persistent ``CMD_BATCH`` channel to the
+root tracker:
+
+* **terminated locally** — heartbeats (a local lease table mirrors the
+  tracker's semantics; live leases are re-advertised upstream once per
+  flush with a padded interval, so the root's lease covers the batching
+  cadence and a relay bounce), metrics snapshots (latest per task wins,
+  exactly the tracker's fold), epoch polls (answered from a cache the
+  batch ACKs refresh), prints and shutdowns (ACKed locally, forwarded in
+  the next flush);
+* **routed** — START/RECOVER/SPARE check-ins park the child connection
+  at the relay, the hello rides the next (immediate) batch upstream, and
+  the tracker's reply (Assignment, park frame) is routed back by task
+  id over the channel — the root accepts O(relays) connections per wave
+  instead of O(world);
+* **proxied** — CMD_QUORUM (decide-once reply) and CMD_BLOB (rank-0
+  blob upload) pass straight through on their own short-lived upstream
+  connections;
+* **clock-projected** — the relay brackets every batch round-trip and
+  keeps an NTP-style offset estimate against the tracker clock; child
+  heartbeat/metrics ACKs carry the PROJECTED tracker time, so PR 3
+  cross-rank clock sync still works per rank through a relay.
+
+Statelessness is the failure model: a dead relay is just a reconnect,
+not a membership event.  Children retry against the same address
+(``tracker_rpc`` backoff), parked check-ins are re-sent when the channel
+reconnects, and the tracker's purge/reap paths treat a dead channel's
+virtual connections as hung up.  Child leases survive a relay bounce
+because the upstream lease interval is padded
+(:data:`RELAY_LEASE_PAD` x the flush cadence).
+"""
+
+from __future__ import annotations
+
+import json
+import selectors
+import socket
+import threading
+import time
+
+from rabit_tpu.tracker import protocol as P
+
+#: Upstream heartbeat padding: a child's lease is re-advertised to the
+#: root with interval ``max(child_interval, flush_sec) * RELAY_LEASE_PAD``
+#: so the root's LEASE_FACTOR x interval lease tolerates one whole missed
+#: flush (a relay bounce + reconnect) without a spurious lease_expired.
+#: The RELAY's local lease (the child's true interval) stays the fast
+#: detector; the root's padded lease is the backstop.
+RELAY_LEASE_PAD = 2.0
+
+#: How long a held (wave-parked) child write may block the channel
+#: reader before the child is declared gone.
+_HELD_SEND_TIMEOUT = 30.0
+
+
+class _Child:
+    """Per-child-connection state on the relay's reactor loop."""
+
+    __slots__ = ("sock", "addr", "parser", "out", "deadline", "task_id",
+                 "held")
+
+    def __init__(self, sock: socket.socket, addr, deadline: float):
+        self.sock = sock
+        self.addr = addr
+        self.parser = P.StreamParser(P.hello_parser())
+        self.out = bytearray()
+        self.deadline = deadline
+        self.task_id = ""
+        self.held = False
+
+
+class _LocalLease:
+    __slots__ = ("interval", "expires", "prev_rank")
+
+    def __init__(self, interval: float, expires: float, prev_rank: int):
+        self.interval = interval
+        self.expires = expires
+        self.prev_rank = prev_rank
+
+
+class Relay:
+    """One relay process/node (see module docstring).
+
+    Runs two loops: a selectors-based child reactor (accept, parse,
+    terminate-or-park) and an upstream pump (flush one coalesced batch
+    per ``flush_sec`` — immediately when a check-in or shutdown is
+    queued — plus a channel reader routing tracker replies to parked
+    children).  ``start()``/``stop()`` bound every thread; nothing here
+    blocks unboundedly.
+    """
+
+    def __init__(self, tracker: tuple[str, int], relay_id: str = "r0",
+                 host: str = "127.0.0.1", port: int = 0,
+                 flush_sec: float = 0.25, backlog: int = 1024,
+                 rpc_timeout: float = 5.0, quiet: bool = True):
+        self.tracker = (tracker[0], int(tracker[1]))
+        self.relay_id = relay_id
+        self.flush_sec = float(flush_sec)
+        self.rpc_timeout = float(rpc_timeout)
+        self.quiet = quiet
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind((host, port))
+        self._srv.listen(backlog)
+        self.host, self.port = self._srv.getsockname()
+        self._stopped = threading.Event()
+        self._lock = threading.Lock()
+        # coalesced upstream state (drained per flush; all under _lock)
+        self._leases: dict[str, _LocalLease] = {}
+        self._metrics: dict[str, tuple[int, bytes, float]] = {}
+        self._queued: list[P.BatchMsg] = []
+        self._held: dict[str, socket.socket] = {}   # parked check-ins
+        self._held_msg: dict[str, P.BatchMsg] = {}  # their hellos (for
+        #                                             re-send on reconnect)
+        self._held_sent: set[str] = set()
+        # Sockets other threads want closed: ONLY the child reactor may
+        # close a registered socket (a cross-thread close frees the fd
+        # while it is still registered, and the next accept's fd reuse
+        # then fails to register).
+        self._defer_close: set[socket.socket] = set()
+        self._flush_now = threading.Event()
+        # upstream channel + tracker-clock projection
+        self._chan: socket.socket | None = None
+        self._chan_lock = threading.Lock()
+        self._ack = threading.Event()
+        self._partitioned = False
+        self.clock_offset = 0.0   # tracker_ts - relay_ts
+        self.clock_err = float("inf")
+        self._epoch_cache = {"epoch": 0, "world": 0, "rewave": False}
+        # evidence counters
+        self.stats = {"children": 0, "rpcs_terminated": 0, "batches": 0,
+                      "batch_msgs": 0, "routed": 0, "reconnects": 0}
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "Relay":
+        threading.Thread(target=self._serve_children, daemon=True,
+                         name=f"relay-children-{self.relay_id}").start()
+        threading.Thread(target=self._upstream_pump, daemon=True,
+                         name=f"relay-upstream-{self.relay_id}").start()
+        return self
+
+    def stop(self) -> None:
+        self._stopped.set()
+        self._flush_now.set()
+        try:
+            self._srv.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+        self._drop_channel()
+        with self._lock:
+            held, self._held = self._held, {}
+            self._held_msg.clear()
+            self._held_sent.clear()
+        for conn in held.values():
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def set_partition(self, on: bool) -> None:
+        """Chaos hook (doc/scaling.md): while partitioned the relay keeps
+        serving its children locally but cannot reach the root — batches
+        fail, the channel stays down, and held check-ins park until the
+        heal.  The root's padded leases decide whether the window was
+        survivable."""
+        self._partitioned = bool(on)
+        if on:
+            self._drop_channel()
+        else:
+            self._flush_now.set()
+
+    # -- tracker-clock projection ------------------------------------------
+
+    def _stamp(self) -> bytes:
+        """The PROJECTED tracker clock, in the exact format of the
+        tracker's own metrics/heartbeat ACK stamp — children's ClockSync
+        keeps estimating tracker_ts - worker_ts through a relay."""
+        return P.put_str(f"{time.time() + self.clock_offset:.6f}")
+
+    # -- child reactor ------------------------------------------------------
+
+    def _serve_children(self) -> None:
+        sel = selectors.DefaultSelector()
+        self._srv.setblocking(False)
+        try:
+            sel.register(self._srv, selectors.EVENT_READ, None)
+        except (OSError, ValueError):
+            return
+        children: set[_Child] = set()
+        next_sweep = time.monotonic() + 0.5
+        try:
+            while not self._stopped.is_set():
+                try:
+                    events = sel.select(0.05)
+                except OSError:
+                    break
+                for key, mask in events:
+                    if key.data is None:
+                        self._accept_children(sel, children)
+                    elif mask & selectors.EVENT_READ:
+                        self._child_read(sel, children, key.data)
+                    elif mask & selectors.EVENT_WRITE:
+                        self._child_flush(sel, children, key.data)
+                if self._defer_close:
+                    with self._lock:
+                        dead, self._defer_close = self._defer_close, set()
+                    for ch in [c for c in children if c.sock in dead]:
+                        self._child_drop(sel, children, ch)
+                        dead.discard(ch.sock)
+                    for sock in dead:  # never registered / already dropped
+                        try:
+                            sock.close()
+                        except OSError:
+                            pass
+                now = time.monotonic()
+                if now >= next_sweep:
+                    next_sweep = now + 0.5
+                    self._expire_local_leases()
+                    for ch in [c for c in children
+                               if c.deadline and now > c.deadline]:
+                        self._child_drop(sel, children, ch)
+        finally:
+            for ch in list(children):
+                self._child_drop(sel, children, ch)
+            sel.close()
+
+    def _accept_children(self, sel, children: set[_Child]) -> None:
+        while True:
+            try:
+                conn, addr = self._srv.accept()
+            except (BlockingIOError, InterruptedError, OSError):
+                return
+            conn.setblocking(False)
+            ch = _Child(conn, addr, time.monotonic() + 60.0)
+            try:
+                sel.register(conn, selectors.EVENT_READ, ch)
+            except (OSError, ValueError):
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                continue
+            children.add(ch)
+            self.stats["children"] += 1
+
+    def _child_drop(self, sel, children: set[_Child], ch: _Child) -> None:
+        children.discard(ch)
+        try:
+            sel.unregister(ch.sock)
+        except (KeyError, OSError, ValueError):
+            pass
+        if ch.held:
+            # A parked check-in hung up: tell the tracker so the wave
+            # purge counts live survivors only.  Guard against a stale
+            # entry for a task that re-checked-in on a fresh connection.
+            self._unhold(ch.task_id, notify=True, expect=ch.sock)
+        try:
+            ch.sock.close()
+        except OSError:
+            pass
+
+    def _child_detach(self, sel, children: set[_Child], ch: _Child) -> None:
+        children.discard(ch)
+        try:
+            sel.unregister(ch.sock)
+        except (KeyError, OSError, ValueError):
+            pass
+        ch.sock.setblocking(True)
+
+    def _child_read(self, sel, children: set[_Child], ch: _Child) -> None:
+        try:
+            data = ch.sock.recv(65536)
+        except (BlockingIOError, InterruptedError):
+            return
+        except OSError:
+            self._child_drop(sel, children, ch)
+            return
+        if not data:
+            self._child_drop(sel, children, ch)
+            return
+        if ch.held:
+            return  # held children never speak past their hello
+        try:
+            if not ch.parser.feed(data):
+                return
+            h = ch.parser.result
+        except ValueError:
+            self._child_drop(sel, children, ch)
+            return
+        ch.task_id = h.task_id
+        self._dispatch_child(sel, children, ch, h)
+
+    def _dispatch_child(self, sel, children: set[_Child], ch: _Child,
+                        h: P.Hello) -> None:
+        now = time.monotonic()
+        if h.cmd in (P.CMD_START, P.CMD_RECOVER, P.CMD_SPARE):
+            # Park the connection; the hello rides the next (immediate)
+            # batch and the tracker's reply is routed back by task id.
+            # The conn STAYS on the selector (read-registered) so an EOF
+            # while parked is noticed and reported upstream.
+            ch.held = True
+            ch.deadline = 0.0
+            msg = P.BatchMsg(h.task_id, h.cmd, h.prev_rank,
+                             ch.addr[0], h.listen_port, b"", time.time())
+            with self._lock:
+                old = self._held.pop(h.task_id, None)
+                self._held[h.task_id] = ch.sock
+                self._held_msg[h.task_id] = msg
+                self._held_sent.discard(h.task_id)
+                if h.cmd != P.CMD_SPARE:
+                    self._leases.pop(h.task_id, None)
+            if old is not None and old is not ch.sock:
+                with self._lock:
+                    self._defer_close.add(old)
+            self._flush_now.set()
+            return
+        if h.cmd in (P.CMD_QUORUM, P.CMD_BLOB):
+            # Proxy straight through: the reply must be synchronous and
+            # decided by the root (quorum decide-once; blob versioning).
+            self._child_detach(sel, children, ch)
+            threading.Thread(target=self._proxy_rpc, args=(ch.sock, h),
+                             daemon=True,
+                             name=f"relay-proxy-{self.relay_id}").start()
+            return
+        self.stats["rpcs_terminated"] += 1
+        if h.cmd == P.CMD_HEARTBEAT:
+            try:
+                interval = float(h.message)
+            except ValueError:
+                interval = 0.0
+            if 0 < interval < 86400:
+                with self._lock:
+                    self._leases[h.task_id] = _LocalLease(
+                        interval,
+                        now + P.LEASE_FACTOR * interval, h.prev_rank)
+            ch.out += P.put_u32(P.ACK) + self._stamp()
+        elif h.cmd == P.CMD_METRICS:
+            with self._lock:
+                self._metrics[h.task_id] = (h.prev_rank,
+                                            h.message.encode(), time.time())
+            ch.out += P.put_u32(P.ACK) + self._stamp()
+        elif h.cmd == P.CMD_EPOCH:
+            ch.out += (P.put_u32(P.ACK)
+                       + P.put_str(json.dumps(self._epoch_cache)))
+        elif h.cmd == P.CMD_PRINT:
+            with self._lock:
+                self._queued.append(P.BatchMsg(
+                    h.task_id, P.CMD_PRINT, h.prev_rank, ch.addr[0], 0,
+                    h.message.encode(), time.time()))
+            ch.out += P.put_u32(P.ACK)
+        elif h.cmd == P.CMD_SHUTDOWN:
+            with self._lock:
+                self._leases.pop(h.task_id, None)
+                self._queued.append(P.BatchMsg(
+                    h.task_id, P.CMD_SHUTDOWN, h.prev_rank, ch.addr[0], 0,
+                    b"", time.time()))
+            self._flush_now.set()  # completion accounting must not wait
+            ch.out += P.put_u32(P.ACK)
+        else:
+            self._child_drop(sel, children, ch)
+            return
+        self._child_flush(sel, children, ch)
+
+    def _child_flush(self, sel, children: set[_Child], ch: _Child) -> None:
+        while ch.out:
+            try:
+                n = ch.sock.send(ch.out)
+            except (BlockingIOError, InterruptedError):
+                try:
+                    sel.modify(ch.sock, selectors.EVENT_WRITE, ch)
+                except (KeyError, OSError, ValueError):
+                    self._child_drop(sel, children, ch)
+                return
+            except OSError:
+                self._child_drop(sel, children, ch)
+                return
+            del ch.out[:n]
+        self._child_drop(sel, children, ch)
+
+    def _proxy_rpc(self, conn: socket.socket, h: P.Hello) -> None:
+        """Pass one CMD_QUORUM/CMD_BLOB through to the root and relay the
+        reply bytes back verbatim."""
+        try:
+            try:
+                with socket.create_connection(
+                        self.tracker, timeout=self.rpc_timeout) as up:
+                    up.settimeout(self.rpc_timeout)
+                    P.send_hello(up, h.cmd, h.task_id,
+                                 prev_rank=h.prev_rank, message=h.message,
+                                 blob=h.blob, blob_version=h.blob_version)
+                    ack = P.get_u32(up)
+                    reply = P.put_u32(ack)
+                    if h.cmd == P.CMD_QUORUM:
+                        reply += P.put_str(P.get_str(up))
+                conn.settimeout(self.rpc_timeout)
+                conn.sendall(reply)
+            except (ConnectionError, OSError, ValueError):
+                pass  # child's bounded RPC retries; proxy must not wedge
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _expire_local_leases(self) -> None:
+        """Drop local leases past LEASE_FACTOR x interval: the child is
+        gone, so its upstream renewals stop and the root's padded lease
+        expires it — detection through a relay is local-lease + padded-
+        lease, both bounded."""
+        now = time.monotonic()
+        with self._lock:
+            for task_id in [t for t, l in self._leases.items()
+                            if now >= l.expires]:
+                del self._leases[task_id]
+
+    def _unhold(self, task_id: str, notify: bool,
+                expect: socket.socket | None = None) -> None:
+        with self._lock:
+            if expect is not None and self._held.get(task_id) is not expect:
+                return  # superseded by a fresh check-in; leave it alone
+            self._held.pop(task_id, None)
+            self._held_msg.pop(task_id, None)
+            was_sent = task_id in self._held_sent
+            self._held_sent.discard(task_id)
+            if notify and was_sent:
+                self._queued.append(P.BatchMsg(
+                    task_id, P.CMD_HANGUP, -1, "", 0, b"", time.time()))
+                self._flush_now.set()
+
+    # -- upstream pump ------------------------------------------------------
+
+    def _drop_channel(self) -> None:
+        with self._chan_lock:
+            chan, self._chan = self._chan, None
+        if chan is not None:
+            try:
+                chan.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                chan.close()
+            except OSError:
+                pass
+
+    def _connect_channel(self) -> socket.socket | None:
+        if self._partitioned:
+            return None
+        try:
+            chan = socket.create_connection(self.tracker,
+                                            timeout=self.rpc_timeout)
+            chan.settimeout(self.rpc_timeout)
+            P.send_hello(chan, P.CMD_BATCH, self.relay_id)
+            if P.get_u32(chan) != P.ACK:
+                chan.close()
+                return None
+            chan.settimeout(None)
+        except (ConnectionError, OSError, ValueError):
+            return None
+        with self._chan_lock:
+            self._chan = chan
+        with self._lock:
+            # Parked check-ins must be re-announced on a fresh channel:
+            # the tracker replaces a task id's stale pending entry, so a
+            # duplicate hello is safe and a lost one is not.
+            self._held_sent.clear()
+        self.stats["reconnects"] += 1
+        threading.Thread(target=self._channel_reader, args=(chan,),
+                         daemon=True,
+                         name=f"relay-rx-{self.relay_id}").start()
+        if not self.quiet:
+            print(f"[relay {self.relay_id}] channel up to "
+                  f"{self.tracker[0]}:{self.tracker[1]}", flush=True)
+        return chan
+
+    def _channel_reader(self, chan: socket.socket) -> None:
+        """Route tracker frames to parked children until the channel
+        dies.  Runs once per channel incarnation."""
+        try:
+            while not self._stopped.is_set():
+                task_id, flags, payload = P.read_route_frame(chan)
+                if task_id == "":
+                    self._fold_ack(payload)
+                    continue
+                with self._lock:
+                    conn = self._held.get(task_id)
+                if conn is None:
+                    continue  # child gave up and re-checked-in elsewhere
+                self.stats["routed"] += 1
+                try:
+                    conn.settimeout(_HELD_SEND_TIMEOUT)
+                    if payload:
+                        conn.sendall(payload)
+                except OSError:
+                    self._unhold(task_id, notify=True, expect=conn)
+                    with self._lock:
+                        self._defer_close.add(conn)
+                    continue
+                if flags & P.ROUTE_CLOSE:
+                    self._unhold(task_id, notify=False, expect=conn)
+                    with self._lock:
+                        self._defer_close.add(conn)
+        except (ConnectionError, OSError, ValueError):
+            pass
+        finally:
+            with self._chan_lock:
+                if self._chan is chan:
+                    self._chan = None
+            try:
+                chan.close()
+            except OSError:
+                pass
+
+    def _fold_ack(self, payload: bytes) -> None:
+        try:
+            info = json.loads(payload.decode())
+        except (ValueError, UnicodeDecodeError):
+            return
+        if "epoch" in info:
+            self._epoch_cache = {"epoch": info.get("epoch", 0),
+                                 "world": info.get("world", 0),
+                                 "rewave": bool(info.get("rewave"))}
+        t_recv = time.time()
+        t_send = getattr(self, "_last_batch_send", None)
+        server_ts = info.get("server_ts")
+        if t_send is not None and server_ts is not None:
+            err = max(t_recv - t_send, 0.0) / 2.0
+            # best-by-error with decay, mirroring obs.trace.ClockSync's
+            # preference for tight round trips
+            if err <= self.clock_err * 2.0 or err < 0.05:
+                self.clock_offset = float(server_ts) - (t_send + t_recv) / 2
+                self.clock_err = err
+        self._ack.set()
+
+    def _build_batch(self) -> list[P.BatchMsg]:
+        now = time.time()
+        with self._lock:
+            msgs = list(self._queued)
+            self._queued = []
+            for task_id, msg in self._held_msg.items():
+                if task_id not in self._held_sent:
+                    msgs.append(msg)
+                    self._held_sent.add(task_id)
+            # liveness, coalesced: every live local lease re-advertised
+            # with the PADDED upstream interval (see RELAY_LEASE_PAD)
+            pad = RELAY_LEASE_PAD
+            for task_id, lease in self._leases.items():
+                up_interval = max(lease.interval, self.flush_sec) * pad
+                msgs.append(P.BatchMsg(
+                    task_id, P.CMD_HEARTBEAT, lease.prev_rank, "", 0,
+                    f"{up_interval:.6f}".encode(), now))
+            # metrics, coalesced: latest snapshot per task since the
+            # last flush
+            for task_id, (rank, payload, ts) in self._metrics.items():
+                msgs.append(P.BatchMsg(task_id, P.CMD_METRICS, rank, "", 0,
+                                       payload, ts))
+            self._metrics = {}
+        return msgs
+
+    def _upstream_pump(self) -> None:
+        backoff = 0.05
+        while not self._stopped.is_set():
+            self._flush_now.wait(self.flush_sec)
+            self._flush_now.clear()
+            if self._stopped.is_set():
+                return
+            with self._chan_lock:
+                chan = self._chan
+            if chan is None:
+                chan = self._connect_channel()
+                if chan is None:
+                    time.sleep(min(backoff, 1.0))
+                    backoff = min(backoff * 2, 1.0)
+                    continue
+                backoff = 0.05
+            # An empty batch still goes out: it is the keepalive that
+            # refreshes the epoch cache (rewave reaches idle children)
+            # and the clock-offset estimate.
+            msgs = self._build_batch()
+            self._ack.clear()
+            self._last_batch_send = time.time()
+            try:
+                chan.sendall(P.put_batch_frame(msgs))
+            except OSError:
+                # Channel died mid-flush: requeue nothing (heartbeats and
+                # metrics re-coalesce next interval; held hellos re-send
+                # on reconnect via _held_sent), drop, retry.
+                self._drop_channel()
+                continue
+            self.stats["batches"] += 1
+            self.stats["batch_msgs"] += len(msgs)
+            self._ack.wait(self.rpc_timeout)

@@ -1,0 +1,48 @@
+"""Standalone relay process: ``python -m rabit_tpu.relay --tracker H:P``.
+
+One relay node of the hierarchical coordination tier (doc/scaling.md).
+Point a shard of workers' ``DMLC_TRACKER_URI``/``DMLC_TRACKER_PORT`` at
+the address this prints; the relay terminates their liveness/metrics
+RPCs locally and batches upstream.  The in-process launcher
+(``rabit_tpu.tracker.launcher --relays R``) hosts relays directly; this
+entry point is for real multi-host deployments.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+from rabit_tpu.relay import Relay
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--tracker", required=True, metavar="HOST:PORT",
+                    help="root tracker address")
+    ap.add_argument("--id", default="r0", help="relay id (telemetry)")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=0,
+                    help="child-facing listen port (0 = ephemeral)")
+    ap.add_argument("--flush-sec", type=float, default=0.25,
+                    help="upstream batch cadence")
+    ap.add_argument("--quiet", action="store_true")
+    args = ap.parse_args(argv)
+    host, _, port = args.tracker.rpartition(":")
+    relay = Relay((host or "127.0.0.1", int(port)), relay_id=args.id,
+                  host=args.host, port=args.port,
+                  flush_sec=args.flush_sec, quiet=args.quiet).start()
+    # The launcher-parsable address line (flushed before the serve loop).
+    print(f"[relay {args.id}] listening on {relay.host}:{relay.port}",
+          flush=True)
+    try:
+        while True:
+            time.sleep(1.0)
+    except KeyboardInterrupt:
+        relay.stop()
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -75,6 +75,8 @@ class LocalCluster:
         shrink_after_sec: float = 0.0,
         schedule: str = "auto",
         sched_mesh: str = "",
+        relays: int = 0,
+        relay_flush_sec: float = 0.25,
     ):
         self.num_workers = num_workers
         self.max_restarts = max_restarts
@@ -84,6 +86,14 @@ class LocalCluster:
         self.shrink_after_sec = float(shrink_after_sec)
         self.schedule = schedule
         self.sched_mesh = sched_mesh
+        #: hierarchical relay tier (doc/scaling.md): R in-process relay
+        #: nodes between the workers and the tracker; workers are
+        #: sharded round-robin across them (worker i -> relay i % R), so
+        #: the root tracker serves O(R) connections instead of O(N).
+        #: 0 = direct (the wire bytes workers see are identical).
+        self.num_relays = int(relays)
+        self.relay_flush_sec = float(relay_flush_sec)
+        self.relays: list = []
         #: per-task restart / last-returncode bookkeeping, keyed by TASK ID
         #: (workers "0".."N-1", spares "s0".."sK-1") — dicts, not spawn-
         #: order lists, so elastic membership cannot index out of range.
@@ -130,13 +140,27 @@ class LocalCluster:
         with self._suspect_lock:
             self._suspects.append(task_id)
 
+    def _target_addr(self, tracker: Tracker, task_id: str) -> tuple[str, int]:
+        """The coordination address this task dials: the tracker, or its
+        round-robin relay (stable per task id, so a restarted life lands
+        on the same relay)."""
+        if not self.relays:
+            return tracker.host, tracker.port
+        try:
+            idx = int(task_id.lstrip("s"))
+        except ValueError:
+            idx = sum(task_id.encode())
+        relay = self.relays[idx % len(self.relays)]
+        return relay.host, relay.port
+
     def _spawn(self, cmd: list[str], tracker: Tracker,
                task_id: str, spare: bool = False) -> subprocess.Popen:
+        host, port = self._target_addr(tracker, task_id)
         env = dict(os.environ)
         env.update(self.extra_env)
         env.update(
-            DMLC_TRACKER_URI=tracker.host,
-            DMLC_TRACKER_PORT=str(tracker.port),
+            DMLC_TRACKER_URI=host,
+            DMLC_TRACKER_PORT=str(port),
             DMLC_TASK_ID=task_id,
             DMLC_NUM_ATTEMPT=str(self.restarts[task_id]),
         )
@@ -180,6 +204,15 @@ class LocalCluster:
                           sched_mesh=self.sched_mesh).start()
         self.messages = tracker.messages
         self.events = tracker.events
+        if self.num_relays > 0:
+            from rabit_tpu.relay import Relay
+
+            self.relays = [
+                Relay((tracker.host, tracker.port), relay_id=f"relay{i}",
+                      flush_sec=self.relay_flush_sec,
+                      quiet=self.quiet).start()
+                for i in range(self.num_relays)
+            ]
         primaries = [str(i) for i in range(self.num_workers)]
         procs: dict[str, subprocess.Popen | None] = {
             t: self._spawn(cmd, tracker, t) for t in primaries}
@@ -325,6 +358,9 @@ class LocalCluster:
                 if proc is not None and proc.poll() is None:
                     proc.kill()
                     proc.wait()
+            for relay in self.relays:
+                relay.stop()
+            self.relays = []
             tracker.stop()  # also flushes telemetry.json (idempotent)
             self.telemetry = tracker.telemetry
 
@@ -340,6 +376,12 @@ def main(argv: list[str] | None = None) -> int:
         help="launch K hot-spare processes (rabit_spare=1; task ids "
              "s0..s{K-1}) that park in the tracker's pool and are promoted "
              "into dead ranks' slots (doc/elasticity.md)",
+    )
+    ap.add_argument(
+        "--relays", type=int, default=0, metavar="R",
+        help="interpose R relay nodes between the workers and the "
+             "tracker (hierarchical fan-out; workers shard round-robin "
+             "across them — doc/scaling.md).  0 = direct",
     )
     ap.add_argument(
         "--shrink-after", type=float, default=0.0, metavar="SEC",
@@ -396,7 +438,8 @@ def main(argv: list[str] | None = None) -> int:
                            quiet=args.quiet, spares=args.spares,
                            shrink_after_sec=args.shrink_after,
                            schedule=args.schedule,
-                           sched_mesh=args.sched_mesh)
+                           sched_mesh=args.sched_mesh,
+                           relays=args.relays)
     return cluster.run(cmd, timeout=args.timeout, preempt=preempt, wedge=wedge)
 
 

@@ -14,7 +14,7 @@ worker -> tracker (fresh connection per message):
     u32 MAGIC_HELLO
     u32 cmd          (CMD_START | CMD_RECOVER | CMD_PRINT | CMD_SHUTDOWN
                       | CMD_METRICS | CMD_HEARTBEAT | CMD_SPARE
-                      | CMD_EPOCH | CMD_BLOB | CMD_QUORUM)
+                      | CMD_EPOCH | CMD_BLOB | CMD_QUORUM | CMD_BATCH)
     i32 prev_rank    (-1 if never assigned; stable re-admission key is task_id)
     str task_id
     if start/recover/spare: u32 listen_port (worker binds BEFORE contacting
@@ -96,6 +96,42 @@ tracker -> worker (metrics/heartbeat reply): u32 ACK, str server_ts — the
     two Python-side commands carry the stamp; the native C++ client speaks
     only start/recover/print/shutdown, whose replies are unchanged.
 
+relay <-> tracker channel (doc/scaling.md): a relay (rabit_tpu.relay)
+    establishes ONE persistent duplex channel with the hello above using
+    ``cmd=CMD_BATCH`` (task_id = the relay's id; no listen_port).  The
+    tracker answers ``u32 ACK`` and the connection then switches to
+    framed mode:
+
+    relay -> tracker, one CMD_BATCH envelope per flush interval
+    (``put_batch_frame``): u32 nmsgs, then per sub-message: str task_id,
+    u32 cmd, i32 prev_rank, str host (the child's peer address — the
+    tracker must record the CHILD's host for the peer table, not the
+    relay's), u32 listen_port, u32 nbytes + payload, str recv_ts (the
+    relay's clock when the child's RPC landed).  The relay terminates
+    its children's heartbeat/metrics/epoch/print RPCs locally and
+    coalesces them here — N workers cost the root tracker ONE
+    connection and one frame per interval instead of N accepts per
+    interval.  START/RECOVER/SPARE check-ins ride the same envelope
+    (flushed immediately), so a bootstrap wave costs the root O(relays)
+    connections instead of O(world); a CMD_HANGUP sub-message reports a
+    parked child's EOF so wave purges stay live-survivor-exact.
+    CMD_QUORUM and CMD_BLOB never ride a batch — the relay proxies them
+    straight through (decide-once replies and rank-0 blob uploads need
+    the synchronous path).
+
+    tracker -> relay (``put_route_frame``): str task_id, u32 flags
+    (bit 0 = close the child connection after delivering), u32 nbytes +
+    payload.  A frame with task_id "" is the BATCH ACK: its payload is
+    JSON ``{"server_ts": ..., "acks": [...], "epoch": E, "world": W,
+    "rewave": bool}`` — server_ts is the tracker clock stamped while
+    folding the batch (the relay brackets the batch round-trip and
+    projects its children's heartbeat/metrics ACK stamps onto the
+    tracker clock, so PR 3 clock sync still works per rank through a
+    relay), acks are the per-sub-message tracker-clock ingest stamps,
+    and epoch/world/rewave refresh the relay's local CMD_EPOCH cache.
+    Frames with a task_id route a reply (an Assignment, a MAGIC_BLOB
+    park frame) to that parked child connection.
+
 worker <-> worker link handshake (both directions on connect/accept):
     u32 MAGIC_LINK, i32 my_rank, u32 epoch
 
@@ -135,6 +171,17 @@ CMD_SPARE = 7
 CMD_EPOCH = 8
 CMD_BLOB = 9
 CMD_QUORUM = 10
+CMD_BATCH = 11
+#: Relay-internal sub-message (never a worker hello): the relay observed
+#: a parked child hang up (EOF on its held connection) — the tracker
+#: marks the matching virtual connection dead so the wave purge counts
+#: live survivors only, exactly as _conn_dead does for direct sockets.
+CMD_HANGUP = 12
+
+#: put_route_frame flags bit 0: close the child connection after
+#: delivering this frame's payload (the tracker's "conn.close()" crossing
+#: the relay channel).
+ROUTE_CLOSE = 1
 
 #: How many renewal intervals a lease survives without a renewal.  2 means
 #: one lost/late heartbeat is tolerated; the second expires the lease, so a
@@ -207,24 +254,12 @@ class Assignment:
     ring_order: list[int] = field(default_factory=list)
 
     def encode(self) -> bytes:
-        out = [
-            put_u32(MAGIC_ASSIGN),
-            put_i32(self.rank),
-            put_u32(self.world_size),
-            put_i32(self.parent),
-            put_u32(len(self.children)),
-        ]
-        out += [put_i32(c) for c in self.children]
-        out += [put_i32(self.ring_prev), put_i32(self.ring_next)]
-        out.append(put_u32(len(self.peers)))
-        for r, (host, port) in sorted(self.peers.items()):
-            out += [put_i32(r), put_str(host), put_u32(port)]
-        out.append(put_u32(self.epoch))
-        out.append(put_u32(len(self.rank_map)))
-        for task_id, r in sorted(self.rank_map.items()):
-            out += [put_str(task_id), put_i32(r)]
-        out.append(put_sched_frame(self.algo, self.ring_order))
-        return b"".join(out)
+        return (assignment_head_bytes(
+                    self.rank, self.world_size, self.parent, self.children,
+                    self.ring_prev, self.ring_next)
+                + assignment_tail_bytes(self.peers, self.epoch,
+                                        self.rank_map, self.algo,
+                                        self.ring_order))
 
     @classmethod
     def recv(cls, sock) -> "Assignment":
@@ -258,6 +293,43 @@ class Assignment:
         algo, ring_order = read_sched_frame(sock)
         return cls(rank, world, parent, children, ring_prev, ring_next,
                    peers, epoch, rank_map, algo, ring_order)
+
+
+def assignment_head_bytes(rank: int, world_size: int, parent: int,
+                          children: list[int], ring_prev: int,
+                          ring_next: int) -> bytes:
+    """The per-member PREFIX of an encoded Assignment (magic through the
+    legacy ring neighbors).  Split out so the tracker can encode one
+    wave's shared suffix ONCE (:func:`assignment_tail_bytes`) instead of
+    re-walking the full O(world) peer table and rank_map per member —
+    at world 4096 the per-member encode is what dominated wave latency."""
+    out = [
+        put_u32(MAGIC_ASSIGN),
+        put_i32(rank),
+        put_u32(world_size),
+        put_i32(parent),
+        put_u32(len(children)),
+    ]
+    out += [put_i32(c) for c in children]
+    out += [put_i32(ring_prev), put_i32(ring_next)]
+    return b"".join(out)
+
+
+def assignment_tail_bytes(peers: dict[int, tuple[str, int]], epoch: int,
+                          rank_map: dict[str, int], algo: str,
+                          ring_order: list[int]) -> bytes:
+    """The member-independent SUFFIX of an encoded Assignment (peer
+    table, epoch, rank_map, trailing schedule frame) — identical bytes
+    for every member of one wave."""
+    out = [put_u32(len(peers))]
+    for r, (host, port) in sorted(peers.items()):
+        out += [put_i32(r), put_str(host), put_u32(port)]
+    out.append(put_u32(epoch))
+    out.append(put_u32(len(rank_map)))
+    for task_id, r in sorted(rank_map.items()):
+        out += [put_str(task_id), put_i32(r)]
+    out.append(put_sched_frame(algo, ring_order))
+    return b"".join(out)
 
 
 def tree_topology(rank: int, world: int) -> tuple[int, list[int]]:
@@ -358,6 +430,159 @@ def recv_blob_frame(sock) -> tuple[int, bytes]:
     version = get_u32(sock)
     n = get_u32(sock)
     return version, recv_exact(sock, n) if n else b""
+
+
+@dataclass
+class BatchMsg:
+    """One relayed sub-message inside a CMD_BATCH envelope (see module
+    docstring): the child's hello fields plus the child's peer host (the
+    relay observed it; the tracker must not record the relay's address)
+    and the relay-clock receive stamp."""
+
+    task_id: str
+    cmd: int
+    prev_rank: int = -1
+    host: str = ""
+    listen_port: int = 0
+    payload: bytes = b""
+    recv_ts: float = 0.0
+
+
+def put_batch_frame(msgs: list[BatchMsg]) -> bytes:
+    """Encode one CMD_BATCH envelope (relay -> tracker): N coalesced
+    sub-messages, one framed write per flush interval."""
+    out = [put_u32(len(msgs))]
+    for m in msgs:
+        out += [put_str(m.task_id), put_u32(m.cmd), put_i32(m.prev_rank),
+                put_str(m.host), put_u32(m.listen_port),
+                put_u32(len(m.payload)), m.payload,
+                put_str(f"{m.recv_ts:.6f}")]
+    return b"".join(out)
+
+
+def read_batch_frame(sock) -> list[BatchMsg]:
+    """Read one CMD_BATCH envelope off the relay channel."""
+    msgs = []
+    for _ in range(get_u32(sock)):
+        task_id = get_str(sock)
+        cmd = get_u32(sock)
+        prev_rank = get_i32(sock)
+        host = get_str(sock)
+        listen_port = get_u32(sock)
+        n = get_u32(sock)
+        payload = recv_exact(sock, n) if n else b""
+        recv_ts = float(get_str(sock) or "0")
+        msgs.append(BatchMsg(task_id, cmd, prev_rank, host, listen_port,
+                             payload, recv_ts))
+    return msgs
+
+
+def put_route_frame(task_id: str, flags: int, payload: bytes) -> bytes:
+    """Encode one tracker -> relay routed reply: deliver ``payload`` to
+    the parked child ``task_id`` (close it when ``flags & ROUTE_CLOSE``);
+    task_id "" is the batch ACK (JSON payload, see module docstring)."""
+    return b"".join([put_str(task_id), put_u32(flags),
+                     put_u32(len(payload)), payload])
+
+
+def read_route_frame(sock) -> tuple[str, int, bytes]:
+    """Read one routed reply off the relay channel; returns
+    ``(task_id, flags, payload)``."""
+    task_id = get_str(sock)
+    flags = get_u32(sock)
+    n = get_u32(sock)
+    return task_id, flags, recv_exact(sock, n) if n else b""
+
+
+@dataclass
+class Hello:
+    """One parsed worker hello (the event-loop serving path's unit of
+    work — see :func:`hello_parser`)."""
+
+    cmd: int
+    prev_rank: int
+    task_id: str
+    listen_port: int = 0
+    message: str = ""
+    blob_version: int = 0
+    blob: bytes = b""
+
+
+def hello_parser():
+    """Generator-based INCREMENTAL parser of one worker hello — the
+    event-loop tracker (and the relay's child loop) cannot block a
+    thread per connection on ``recv_exact``, so this parser yields the
+    number of bytes it needs next and is fed exact chunks by
+    :class:`StreamParser`; it returns a :class:`Hello` (or raises
+    ValueError on a bad magic/overlong field).  One generator instance
+    parses exactly one hello."""
+    magic = _U32.unpack((yield 4))[0]
+    if magic != MAGIC_HELLO:
+        raise ValueError(f"bad hello magic {magic:#x}")
+    cmd = _U32.unpack((yield 4))[0]
+    prev_rank = _I32.unpack((yield 4))[0]
+    n = _U32.unpack((yield 4))[0]
+    if n > 1 << 16:
+        raise ValueError(f"oversized task_id ({n} bytes)")
+    task_id = (yield n).decode() if n else ""
+    if cmd in (CMD_START, CMD_RECOVER, CMD_SPARE):
+        listen_port = _U32.unpack((yield 4))[0]
+        return Hello(cmd, prev_rank, task_id, listen_port=listen_port)
+    if cmd in (CMD_PRINT, CMD_METRICS, CMD_HEARTBEAT, CMD_EPOCH,
+               CMD_QUORUM):
+        n = _U32.unpack((yield 4))[0]
+        if n > 64 << 20:
+            raise ValueError(f"oversized message ({n} bytes)")
+        message = (yield n).decode() if n else ""
+        return Hello(cmd, prev_rank, task_id, message=message)
+    if cmd == CMD_BLOB:
+        version = _U32.unpack((yield 4))[0]
+        n = _U32.unpack((yield 4))[0]
+        if n > 1 << 30:
+            raise ValueError(f"oversized blob ({n} bytes)")
+        blob = (yield n) if n else b""
+        return Hello(cmd, prev_rank, task_id, blob_version=version,
+                     blob=blob)
+    # CMD_SHUTDOWN / CMD_BATCH (and anything future): the base hello is
+    # the whole message.
+    return Hello(cmd, prev_rank, task_id)
+
+
+class StreamParser:
+    """Drives a byte-count generator parser over a nonblocking stream:
+    ``feed()`` buffered chunks as they arrive; ``done`` flips when the
+    parser returned (``result`` holds its return value).  Raises
+    whatever the parser raises (bad magic, oversized field)."""
+
+    def __init__(self, gen):
+        self._gen = gen
+        self._need = next(gen)
+        self._buf = bytearray()
+        self.done = False
+        self.result = None
+
+    def feed(self, data: bytes) -> bool:
+        """Feed newly received bytes; returns True when parsing
+        completed (extra bytes beyond the message stay in ``rest()``)."""
+        if self.done:
+            self._buf += data
+            return True
+        self._buf += data
+        while len(self._buf) >= self._need:
+            chunk = bytes(self._buf[:self._need])
+            del self._buf[:self._need]
+            try:
+                self._need = self._gen.send(chunk)
+            except StopIteration as stop:
+                self.result = stop.value
+                self.done = True
+                return True
+        return False
+
+    def rest(self) -> bytes:
+        """Bytes received beyond the parsed message (a pipelined client
+        — e.g. a relay that wrote its first batch behind the hello)."""
+        return bytes(self._buf)
 
 
 class TimedAck(int):

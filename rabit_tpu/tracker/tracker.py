@@ -53,6 +53,22 @@ not buffered); a rank excluded ``quorum_flag_after`` rounds in a row
 feeds the SAME degraded-link avoid-set machinery as a slow link, so the
 next plan moves the persistent straggler off the ring hot path.
 
+Serving at scale (doc/scaling.md): every short-lived RPC (heartbeat,
+metrics, epoch poll, quorum report, print, blob, shutdown) is served by
+ONE ``selectors``-based reactor thread — no thread-per-connection spawn,
+no per-heartbeat thread churn at O(10^4) workers.  Only wave-held
+connections (START/RECOVER check-ins parked until the wave completer
+answers, CMD_SPARE warm sockets) and relay channels leave the reactor
+for dedicated handling.  ``reactor=False`` keeps the legacy
+thread-per-connection path (the scale sweep's comparison arm; the wire
+bytes are identical either way).  The listen backlog is the
+``rabit_tracker_backlog`` config key.  A relay (``rabit_tpu.relay``)
+checks in with ``CMD_BATCH`` and holds one persistent channel: its
+children's coalesced RPCs arrive as framed batches, replies (wave
+assignments, park frames) are routed back by task id — so a world of N
+workers behind R relays costs the root tracker O(R) connections, not
+O(N), for bootstrap and liveness alike.
+
 Collective schedules (doc/scheduling.md): every wave is planned by
 ``rabit_tpu.sched`` — ``rabit_schedule=auto|tree|ring|swing`` picks the
 ring layout over the mesh model, and worker ``slow_link`` reports
@@ -69,13 +85,17 @@ from __future__ import annotations
 
 import json
 import os
+import queue
+import selectors
 import socket
 import threading
 import time
+from collections import deque
 from dataclasses import dataclass
 from typing import Callable
 
 from rabit_tpu import sched
+from rabit_tpu.config import Config
 from rabit_tpu.elastic.membership import CLOSE, MembershipManager
 from rabit_tpu.obs.events import event_from_stats_line
 from rabit_tpu.quorum import QuorumTable
@@ -113,6 +133,127 @@ class _Lease:
     expires: float   # time.monotonic() deadline
     interval: float  # the worker's renewal cadence (seconds)
     rank: int        # rank the worker reported at renewal (-1 pre-assignment)
+
+
+class _RelayChannel:
+    """One relay's persistent duplex channel.  Reads (batch frames) stay
+    on the channel's dedicated server thread; writes (routed replies,
+    batch ACKs) are serialized through a queue drained by one writer
+    thread, so any tracker thread — the wave completer, the reactor, the
+    channel server itself — can enqueue without blocking or locking
+    around a socket send."""
+
+    def __init__(self, sock: socket.socket, relay_id: str):
+        self.sock = sock
+        self.relay_id = relay_id
+        self.dead = False
+        #: live virtual connections by task id (CMD_HANGUP folds flip
+        #: the matching one dead so wave purges see the EOF)
+        self.vconns: dict[str, "_RelayedConn"] = {}
+        self._q: queue.Queue = queue.Queue()
+        self._writer = threading.Thread(target=self._drain, daemon=True,
+                                        name=f"rabit-relay-tx-{relay_id}")
+        self._writer.start()
+
+    def _drain(self) -> None:
+        while True:
+            frame = self._q.get()
+            if frame is None or self.dead:
+                break
+            try:
+                self.sock.sendall(frame)
+            except OSError:
+                self.dead = True
+                break
+
+    def send_route(self, task_id: str, flags: int, payload: bytes) -> bool:
+        """Enqueue one routed frame; False when the channel is dead (the
+        caller treats the child as a hung-up connection)."""
+        if self.dead:
+            return False
+        self._q.put(P.put_route_frame(task_id, flags, payload))
+        return True
+
+    def close(self) -> None:
+        self.dead = True
+        self._q.put(None)
+        for how in (socket.SHUT_RDWR,):
+            try:
+                self.sock.shutdown(how)
+            except OSError:
+                pass
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+class _RelayedConn:
+    """A virtual worker connection riding a relay channel: duck-types
+    the few socket methods the wave machinery touches (``sendall``,
+    ``close``, ``recv`` for the ``_conn_dead`` peek, ``settimeout``),
+    routing bytes to the child parked at the relay.  A dead channel
+    makes every relayed conn read as hung up, so the ordinary
+    dead-pending purge and spare reaping clean up after a relay death —
+    a dead relay is a reconnect, not a membership event."""
+
+    def __init__(self, channel: _RelayChannel, task_id: str):
+        self._channel = channel
+        self.task_id = task_id
+        self._closed = False
+        self.child_dead = False  # relay reported the child hung up
+        channel.vconns[task_id] = self
+
+    def sendall(self, data: bytes) -> None:
+        if self.child_dead or not self._channel.send_route(
+                self.task_id, 0, bytes(data)):
+            raise OSError("relay channel down")
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        if self._channel.vconns.get(self.task_id) is self:
+            self._channel.vconns.pop(self.task_id, None)
+        self._channel.send_route(self.task_id, P.ROUTE_CLOSE, b"")
+
+    def recv(self, n: int, flags: int = 0) -> bytes:
+        if self._channel.dead or self._closed or self.child_dead:
+            return b""  # reads as EOF: the purge paths drop us
+        raise BlockingIOError  # open and idle — the normal pending state
+
+    def settimeout(self, timeout) -> None:  # noqa: ARG002 — socket parity
+        pass
+
+
+class _BufferedSock:
+    """A recv shim serving buffered bytes first — covers a client that
+    pipelined bytes behind the message the reactor already parsed."""
+
+    def __init__(self, sock: socket.socket, rest: bytes):
+        self._sock = sock
+        self._rest = bytearray(rest)
+
+    def recv(self, n: int) -> bytes:
+        if self._rest:
+            out = bytes(self._rest[:n])
+            del self._rest[:n]
+            return out
+        return self._sock.recv(n)
+
+
+class _RConn:
+    """Per-connection reactor state: the incremental hello parser, the
+    pending reply bytes, and the read deadline for torn hellos."""
+
+    __slots__ = ("sock", "addr", "parser", "out", "deadline")
+
+    def __init__(self, sock: socket.socket, addr, deadline: float):
+        self.sock = sock
+        self.addr = addr
+        self.parser = P.StreamParser(P.hello_parser())
+        self.out = bytearray()
+        self.deadline = deadline
 
 
 def assign_ranks(
@@ -206,7 +347,10 @@ class Tracker:
                  sched_repair: bool = True,
                  sched_wait_share: float = 0.25,
                  quorum: str = "",
-                 quorum_flag_after: int = 3):
+                 quorum_flag_after: int = 3,
+                 reactor: bool = True,
+                 backlog: int | None = None,
+                 max_messages: int = 4096):
         #: CURRENT world size — mutable under elastic membership (shrink/
         #: grow); ``base_world`` is the launch size and grow-back target.
         self.world_size = world_size
@@ -275,13 +419,25 @@ class Tracker:
         self._quorum = (QuorumTable(quorum, flag_after=quorum_flag_after)
                         if quorum else None)
         self._last_ring: list[int] = []
+        # Serving model (doc/scaling.md): reactor=True (default) serves
+        # every short-lived RPC on one selectors loop; False keeps the
+        # legacy thread-per-connection path (wire-identical — the scale
+        # sweep's comparison arm).  The listen backlog comes from the
+        # rabit_tracker_backlog config key unless pinned by the caller:
+        # a 4096-worker wave is an accept storm, and a short backlog
+        # turns it into SYN-retransmit latency.
+        self._reactor = bool(reactor)
+        if backlog is None:
+            backlog = Config().get_int("rabit_tracker_backlog", 1024)
+        self.backlog = max(int(backlog), 1)
         self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._srv.bind((host, port))
-        self._srv.listen(256)
+        self._srv.listen(self.backlog)
         self.host, self.port = self._srv.getsockname()
         self._lock = threading.Lock()
         self._pending: list[_Pending] = []
+        self._pending_ids: set[str] = set()  # O(1) re-check-in detection
         self._wave_started: float | None = None  # monotonic, first check-in
         self._spares: list[_Pending] = []  # parked hot spares (warm sockets)
         self._blob: tuple[int, bytes] | None = None  # (version, compressed)
@@ -290,12 +446,31 @@ class Tracker:
         self._shutdown_tasks: set[str] = set()
         self._done = threading.Event()
         self._thread: threading.Thread | None = None
-        self.messages: list[str] = []  # worker print log (also echoed)
+        # Worker print log (also echoed): BOUNDED — at O(10^4) workers an
+        # unbounded list is a memory leak; drops are counted and surfaced
+        # in telemetry.json as messages_dropped.
+        self.messages: deque[str] = deque(maxlen=max(int(max_messages), 1))
+        self.messages_dropped = 0
+        # Serving-path evidence (the scale sweep's FD/thread story):
+        # accepts = connections the root tracker ever accepted,
+        # handler_threads_hwm = peak live thread-per-connection handlers
+        # (legacy path), reactor_conns_hwm = peak connections registered
+        # on the reactor loop, rpcs = short RPCs answered, batches /
+        # batch_msgs = relay envelopes folded and sub-messages therein.
+        self.serve_stats: dict[str, int] = {
+            "accepts": 0, "rpcs": 0, "handler_threads_hwm": 0,
+            "reactor_conns_hwm": 0, "batches": 0, "batch_msgs": 0,
+        }
+        self._stats_lock = threading.Lock()
+        self._handler_threads = 0
+        self._relay_channels: list[_RelayChannel] = []
 
     # -- lifecycle ---------------------------------------------------------
 
     def start(self) -> "Tracker":
-        self._thread = threading.Thread(target=self._serve, daemon=True)
+        serve = self._serve_reactor if self._reactor else self._serve
+        self._thread = threading.Thread(target=serve, daemon=True,
+                                        name="rabit-tracker-serve")
         self._thread.start()
         threading.Thread(target=self._lease_monitor, daemon=True,
                          name="rabit-tracker-leases").start()
@@ -321,6 +496,10 @@ class Tracker:
             self._srv.close()
         except OSError:
             pass
+        with self._lock:
+            channels, self._relay_channels = self._relay_channels, []
+        for ch in channels:
+            ch.close()
         self._release_spares()
         # Safety net for jobs torn down without a full shutdown wave (kill,
         # timeout): idempotent, so the normal all-ranks-shut-down path has
@@ -345,14 +524,31 @@ class Tracker:
     # -- serving -----------------------------------------------------------
 
     def _serve(self) -> None:
+        """LEGACY serving path: one thread per connection.  Kept (behind
+        ``reactor=False``) as the scale sweep's comparison arm — it is
+        the accept storm the reactor replaces."""
         while not self._done.is_set():
             try:
                 conn, addr = self._srv.accept()
             except OSError:
                 break
+            with self._stats_lock:
+                self.serve_stats["accepts"] += 1
             threading.Thread(
-                target=self._handle, args=(conn, addr), daemon=True
+                target=self._handle_counted, args=(conn, addr), daemon=True
             ).start()
+
+    def _handle_counted(self, conn: socket.socket, addr) -> None:
+        with self._stats_lock:
+            self._handler_threads += 1
+            self.serve_stats["handler_threads_hwm"] = max(
+                self.serve_stats["handler_threads_hwm"],
+                self._handler_threads)
+        try:
+            self._handle(conn, addr)
+        finally:
+            with self._stats_lock:
+                self._handler_threads -= 1
 
     def _handle(self, conn: socket.socket, addr) -> None:
         try:
@@ -390,102 +586,417 @@ class Tracker:
                                  prev_rank)
                 # conn stays open (the warm socket); promotion answers it.
                 return
-            if cmd == P.CMD_EPOCH:
-                # The version-boundary poll: the worker's committed version
-                # rides as the payload (informational); the reply carries
-                # the current epoch and the rewave flag that triggers the
-                # grow-back wave (doc/elasticity.md).
-                P.get_str(conn)
-                with self._lock:
-                    self._reap_spares_locked()
-                    # rewave on grow-back AND on a pending schedule
-                    # repair: both resolve at the same version-boundary
-                    # wave (doc/scheduling.md, "Repair policy").
-                    info = {"epoch": self.elastic.epoch,
-                            "world": self.world_size,
-                            "rewave": (self.elastic.grow_wanted(
-                                len(self._spares))
-                                or self._repair_wanted)}
-                conn.sendall(P.put_u32(P.ACK) + P.put_str(json.dumps(info)))
-            elif cmd == P.CMD_BLOB:
-                version = P.get_u32(conn)
+            if cmd == P.CMD_BATCH:
+                # A relay's persistent channel (doc/scaling.md): this
+                # thread BECOMES the channel server.
+                conn.settimeout(None)
+                self._serve_relay(conn, task_id, addr)
+                return
+            hello = P.Hello(cmd, prev_rank, task_id)
+            if cmd == P.CMD_BLOB:
+                hello.blob_version = P.get_u32(conn)
                 nbytes = P.get_u32(conn)
-                data = P.recv_exact(conn, nbytes) if nbytes else b""
-                with self._lock:
-                    if self._blob is None or version >= self._blob[0]:
-                        self._blob = (version, data)
-                    self.events.append({
-                        "ts": round(time.time(), 6),
-                        "kind": "bootstrap_blob", "task_id": task_id,
-                        "version": version, "nbytes": nbytes,
-                    })
-                conn.sendall(P.put_u32(P.ACK))
-            elif cmd == P.CMD_QUORUM:
-                # One quorum-round report (doc/partial_allreduce.md): the
-                # reply is the round's frozen exclusion record, or an
-                # undecided placeholder the worker re-polls past.
-                msg = P.get_str(conn)
-                reply = self._quorum_report(msg)
-                conn.sendall(P.put_u32(P.ACK) + P.put_str(json.dumps(reply)))
-            elif cmd == P.CMD_PRINT:
-                msg = P.get_str(conn)
-                self.messages.append(msg)
-                # Legacy-line bridge: the robust engine's recover_stats /
-                # failure_detected prints become structured events here, so
-                # consumers read self.events / telemetry.json instead of
-                # scraping stdout.
-                ev = event_from_stats_line(msg)
-                if ev is not None:
-                    with self._lock:
-                        self.events.append(
-                            {"ts": round(ev.ts, 6), "kind": ev.kind,
-                             **ev.fields})
-                    if ev.kind == "link_degraded":
-                        self._flag_link(ev.fields)
-                if not self.quiet:
-                    print(msg, end="" if msg.endswith("\n") else "\n", flush=True)
-                conn.sendall(P.put_u32(P.ACK))
-            elif cmd == P.CMD_METRICS:
-                msg = P.get_str(conn)
-                self._accept_snapshot(msg)
-                conn.sendall(P.put_u32(P.ACK) + self._clock_stamp())
-            elif cmd == P.CMD_HEARTBEAT:
-                msg = P.get_str(conn)
-                self._renew_lease(task_id, prev_rank, msg)
-                conn.sendall(P.put_u32(P.ACK) + self._clock_stamp())
-            elif cmd == P.CMD_SHUTDOWN:
-                with self._lock:
-                    # A clean exit must not be suspected afterwards; drop
-                    # the lease BEFORE acking so the worker observing the
-                    # ACK observes the drop too.
-                    self._leases.pop(task_id, None)
-                conn.sendall(P.put_u32(P.ACK))
-                done = False
-                with self._lock:
-                    self._n_shutdown += 1
-                    self._shutdown_tasks.add(task_id)
-                    # Elastic guard on the completion condition: a shrunk
-                    # world can reach n_shutdown >= world_size while OTHER
-                    # workers still hold live leases (they detected the
-                    # failure later and are re-waving toward their own
-                    # epoch).  The job is done only when no leased task
-                    # remains un-shut-down — a dead task's lease expires
-                    # and releases the guard on its own.
-                    done = (self._n_shutdown >= self.world_size
-                            and not (set(self._leases)
-                                     - self._shutdown_tasks))
-                if done:
-                    # Persist BEFORE releasing wait()ers: by the time the
-                    # launcher sees the job done, telemetry.json exists.
-                    self.write_telemetry()
-                    self._done.set()
-                    self._release_spares()
+                hello.blob = P.recv_exact(conn, nbytes) if nbytes else b""
+            elif cmd != P.CMD_SHUTDOWN:
+                hello.message = P.get_str(conn)
+            reply, post = self._short_rpc_reply(hello)
+            conn.sendall(reply)
+            if post is not None:
+                post()
             conn.close()
         except (ConnectionError, OSError, ValueError):
             try:
                 conn.close()
             except OSError:
                 pass
+
+    def _short_rpc_reply(
+            self, h: P.Hello) -> tuple[bytes, Callable[[], None] | None]:
+        """Serve one short-lived RPC: side effects now, reply bytes
+        returned (plus a post-send callable for work that must follow
+        the ACK — shutdown's completion bookkeeping).  Shared verbatim by
+        the threaded path, the reactor, and the relay batch fold, so all
+        three produce identical wire bytes."""
+        with self._stats_lock:
+            self.serve_stats["rpcs"] += 1
+        if h.cmd == P.CMD_EPOCH:
+            # The version-boundary poll: the worker's committed version
+            # rides as the payload (informational); the reply carries
+            # the current epoch and the rewave flag that triggers the
+            # grow-back wave (doc/elasticity.md).
+            with self._lock:
+                self._reap_spares_locked()
+                # rewave on grow-back AND on a pending schedule
+                # repair: both resolve at the same version-boundary
+                # wave (doc/scheduling.md, "Repair policy").
+                info = {"epoch": self.elastic.epoch,
+                        "world": self.world_size,
+                        "rewave": (self.elastic.grow_wanted(
+                            len(self._spares))
+                            or self._repair_wanted)}
+            return P.put_u32(P.ACK) + P.put_str(json.dumps(info)), None
+        if h.cmd == P.CMD_BLOB:
+            with self._lock:
+                if self._blob is None or h.blob_version >= self._blob[0]:
+                    self._blob = (h.blob_version, h.blob)
+                self.events.append({
+                    "ts": round(time.time(), 6),
+                    "kind": "bootstrap_blob", "task_id": h.task_id,
+                    "version": h.blob_version, "nbytes": len(h.blob),
+                })
+            return P.put_u32(P.ACK), None
+        if h.cmd == P.CMD_QUORUM:
+            # One quorum-round report (doc/partial_allreduce.md): the
+            # reply is the round's frozen exclusion record, or an
+            # undecided placeholder the worker re-polls past.
+            reply = self._quorum_report(h.message)
+            return P.put_u32(P.ACK) + P.put_str(json.dumps(reply)), None
+        if h.cmd == P.CMD_PRINT:
+            self._log_print(h.message)
+            return P.put_u32(P.ACK), None
+        if h.cmd == P.CMD_METRICS:
+            self._accept_snapshot(h.message)
+            return P.put_u32(P.ACK) + self._clock_stamp(), None
+        if h.cmd == P.CMD_HEARTBEAT:
+            self._renew_lease(h.task_id, h.prev_rank, h.message)
+            return P.put_u32(P.ACK) + self._clock_stamp(), None
+        if h.cmd == P.CMD_SHUTDOWN:
+            with self._lock:
+                # A clean exit must not be suspected afterwards; drop
+                # the lease BEFORE acking so the worker observing the
+                # ACK observes the drop too.
+                self._leases.pop(h.task_id, None)
+            return P.put_u32(P.ACK), lambda: self._note_shutdown(h.task_id)
+        raise ValueError(f"unknown tracker cmd {h.cmd}")
+
+    def _note_shutdown(self, task_id: str) -> None:
+        """Post-ACK shutdown bookkeeping (the completion guard)."""
+        done = False
+        with self._lock:
+            self._n_shutdown += 1
+            self._shutdown_tasks.add(task_id)
+            # Elastic guard on the completion condition: a shrunk
+            # world can reach n_shutdown >= world_size while OTHER
+            # workers still hold live leases (they detected the
+            # failure later and are re-waving toward their own
+            # epoch).  The job is done only when no leased task
+            # remains un-shut-down — a dead task's lease expires
+            # and releases the guard on its own.
+            done = (self._n_shutdown >= self.world_size
+                    and not (set(self._leases)
+                             - self._shutdown_tasks))
+        if done:
+            # Persist BEFORE releasing wait()ers: by the time the
+            # launcher sees the job done, telemetry.json exists.
+            self.write_telemetry()
+            self._done.set()
+            self._release_spares()
+
+    def _log_print(self, msg: str) -> None:
+        """Fold one worker print into the BOUNDED message log and the
+        stats-line event bridge: the robust engine's recover_stats /
+        failure_detected prints become structured events here, so
+        consumers read self.events / telemetry.json instead of scraping
+        stdout."""
+        if (self.messages.maxlen is not None
+                and len(self.messages) >= self.messages.maxlen):
+            first = self.messages_dropped == 0
+            self.messages_dropped += 1
+            if first:
+                with self._lock:
+                    self.events.append({
+                        "ts": round(time.time(), 6),
+                        "kind": "messages_dropped",
+                        "cap": self.messages.maxlen,
+                    })
+        self.messages.append(msg)
+        ev = event_from_stats_line(msg)
+        if ev is not None:
+            with self._lock:
+                self.events.append(
+                    {"ts": round(ev.ts, 6), "kind": ev.kind,
+                     **ev.fields})
+            if ev.kind == "link_degraded":
+                self._flag_link(ev.fields)
+        if not self.quiet:
+            print(msg, end="" if msg.endswith("\n") else "\n", flush=True)
+
+    # -- event-loop serving (doc/scaling.md) -------------------------------
+
+    def _serve_reactor(self) -> None:
+        """The default serving path: ONE selectors loop owns every
+        short-lived RPC — accept, incremental hello parse, inline reply.
+        Wave-held connections (START/RECOVER/SPARE) detach to the wave
+        machinery once their hello completes; relay channels detach to a
+        dedicated channel thread; wave SENDS run on a completer thread
+        so an O(world) assignment broadcast never stalls the accept
+        path."""
+        sel = selectors.DefaultSelector()
+        self._srv.setblocking(False)
+        try:
+            sel.register(self._srv, selectors.EVENT_READ, None)
+        except (OSError, ValueError):
+            return
+        conns: set[_RConn] = set()
+        next_sweep = time.monotonic() + 0.5
+        try:
+            while not self._done.is_set():
+                try:
+                    events = sel.select(0.05)
+                except OSError:
+                    break
+                for key, mask in events:
+                    if key.data is None:
+                        self._reactor_accept(sel, conns)
+                    elif mask & selectors.EVENT_READ:
+                        self._reactor_read(sel, conns, key.data)
+                    elif mask & selectors.EVENT_WRITE:
+                        self._reactor_flush(sel, conns, key.data)
+                now = time.monotonic()
+                if now >= next_sweep:
+                    next_sweep = now + 0.5
+                    for rc in [r for r in conns
+                               if r.deadline and now > r.deadline]:
+                        # A torn hello past the read deadline must not
+                        # pin its socket (the threaded path's settimeout
+                        # analog).
+                        self._reactor_drop(sel, conns, rc)
+        finally:
+            for rc in list(conns):
+                self._reactor_drop(sel, conns, rc)
+            sel.close()
+
+    def _reactor_accept(self, sel, conns: set[_RConn]) -> None:
+        while True:
+            try:
+                conn, addr = self._srv.accept()
+            except (BlockingIOError, InterruptedError):
+                return
+            except OSError:
+                return
+            conn.setblocking(False)
+            deadline = (time.monotonic() + self.conn_timeout_sec
+                        if self.conn_timeout_sec > 0 else 0.0)
+            rc = _RConn(conn, addr, deadline)
+            try:
+                sel.register(conn, selectors.EVENT_READ, rc)
+            except (OSError, ValueError):
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                continue
+            conns.add(rc)
+            with self._stats_lock:
+                self.serve_stats["accepts"] += 1
+                self.serve_stats["reactor_conns_hwm"] = max(
+                    self.serve_stats["reactor_conns_hwm"], len(conns))
+
+    def _reactor_drop(self, sel, conns: set[_RConn], rc: _RConn) -> None:
+        conns.discard(rc)
+        try:
+            sel.unregister(rc.sock)
+        except (KeyError, OSError, ValueError):
+            pass
+        try:
+            rc.sock.close()
+        except OSError:
+            pass
+
+    def _reactor_detach(self, sel, conns: set[_RConn], rc: _RConn) -> None:
+        """Hand a completed hello's socket OFF the reactor (wave-held
+        connections, relay channels): back to blocking mode, ownership
+        moves to the wave machinery / channel thread."""
+        conns.discard(rc)
+        try:
+            sel.unregister(rc.sock)
+        except (KeyError, OSError, ValueError):
+            pass
+        rc.sock.setblocking(True)
+
+    def _reactor_read(self, sel, conns: set[_RConn], rc: _RConn) -> None:
+        try:
+            data = rc.sock.recv(65536)
+        except (BlockingIOError, InterruptedError):
+            return
+        except OSError:
+            self._reactor_drop(sel, conns, rc)
+            return
+        if not data:
+            self._reactor_drop(sel, conns, rc)
+            return
+        try:
+            if not rc.parser.feed(data):
+                return
+            h = rc.parser.result
+        except ValueError:
+            self._reactor_drop(sel, conns, rc)  # bad magic / oversized
+            return
+        try:
+            if h.cmd in (P.CMD_START, P.CMD_RECOVER):
+                self._reactor_detach(sel, conns, rc)
+                with self._lock:
+                    self._leases.pop(h.task_id, None)
+                self._register(rc.sock, rc.addr[0], h.task_id,
+                               h.listen_port, h.prev_rank, h.cmd,
+                               async_send=True)
+                return
+            if h.cmd == P.CMD_SPARE:
+                # Park replies ship the cached blob (possibly large):
+                # spares are rare, wave-held sockets — a thread each is
+                # the design, not a regression.
+                self._reactor_detach(sel, conns, rc)
+                threading.Thread(
+                    target=self._park_spare,
+                    args=(rc.sock, rc.addr[0], h.task_id, h.listen_port,
+                          h.prev_rank),
+                    daemon=True, name="rabit-tracker-park").start()
+                return
+            if h.cmd == P.CMD_BATCH:
+                self._reactor_detach(sel, conns, rc)
+                rest = rc.parser.rest()
+                threading.Thread(
+                    target=self._serve_relay,
+                    args=(rc.sock, h.task_id, rc.addr, rest),
+                    daemon=True,
+                    name=f"rabit-relay-rx-{h.task_id}").start()
+                return
+            reply, post = self._short_rpc_reply(h)
+        except (ValueError, OSError):
+            self._reactor_drop(sel, conns, rc)
+            return
+        rc.out += reply
+        self._reactor_flush(sel, conns, rc)
+        if post is not None:
+            post()
+
+    def _reactor_flush(self, sel, conns: set[_RConn], rc: _RConn) -> None:
+        """Drain the reply buffer without blocking the loop; a reply that
+        outruns the socket buffer parks on EVENT_WRITE.  A fully drained
+        short-RPC connection closes (one RPC per connection, exactly the
+        threaded path's contract)."""
+        while rc.out:
+            try:
+                n = rc.sock.send(rc.out)
+            except (BlockingIOError, InterruptedError):
+                try:
+                    sel.modify(rc.sock, selectors.EVENT_WRITE, rc)
+                except (KeyError, OSError, ValueError):
+                    self._reactor_drop(sel, conns, rc)
+                return
+            except OSError:
+                self._reactor_drop(sel, conns, rc)
+                return
+            del rc.out[:n]
+        self._reactor_drop(sel, conns, rc)
+
+    # -- relay channels (rabit_tpu.relay; doc/scaling.md) ------------------
+
+    def _serve_relay(self, conn: socket.socket, relay_id: str, addr,
+                     rest: bytes = b"") -> None:
+        """Serve one relay's persistent channel: ACK the hello, then fold
+        framed CMD_BATCH envelopes until EOF.  Replies to relayed
+        children (assignments, park frames) are routed back over the
+        same channel by task id; each batch is answered with a clock-
+        stamped ACK frame the relay syncs its tracker-clock projection
+        and CMD_EPOCH cache from.  A dying channel is NOT a membership
+        event: its virtual connections read as hung up and the ordinary
+        purge/reap paths clean them, while the relay reconnects and its
+        children re-enter."""
+        channel = _RelayChannel(conn, relay_id)
+        try:
+            conn.sendall(P.put_u32(P.ACK))
+        except OSError:
+            channel.close()
+            return
+        with self._lock:
+            self._relay_channels.append(channel)
+            self.events.append({
+                "ts": round(time.time(), 6), "kind": "relay_up",
+                "relay": relay_id, "host": addr[0],
+            })
+        if not self.quiet:
+            print(f"[tracker] relay {relay_id} channel up ({addr[0]})",
+                  flush=True)
+        src = _BufferedSock(conn, rest) if rest else conn
+        try:
+            while not self._done.is_set():
+                msgs = P.read_batch_frame(src)
+                acks = [self._fold_batch_msg(channel, m) for m in msgs]
+                with self._stats_lock:
+                    self.serve_stats["batches"] += 1
+                    self.serve_stats["batch_msgs"] += len(msgs)
+                with self._lock:
+                    self._reap_spares_locked()
+                    info = {"server_ts": round(time.time(), 6),
+                            "acks": acks,
+                            "epoch": self.elastic.epoch,
+                            "world": self.world_size,
+                            "rewave": (self.elastic.grow_wanted(
+                                len(self._spares))
+                                or self._repair_wanted)}
+                    if msgs:  # empty keepalives refresh caches silently
+                        self.events.append({
+                            "ts": info["server_ts"], "kind": "batch_folded",
+                            "relay": relay_id, "n": len(msgs),
+                        })
+                channel.send_route("", 0, json.dumps(info).encode())
+        except (ConnectionError, OSError, ValueError):
+            pass
+        finally:
+            channel.close()
+            with self._lock:
+                if channel in self._relay_channels:
+                    self._relay_channels.remove(channel)
+                self.events.append({
+                    "ts": round(time.time(), 6), "kind": "relay_lost",
+                    "relay": relay_id,
+                })
+            if not self.quiet and not self._done.is_set():
+                print(f"[tracker] relay {relay_id} channel lost "
+                      f"(stateless fan-in: children reconnect)", flush=True)
+
+    def _fold_batch_msg(self, channel: _RelayChannel,
+                        m: P.BatchMsg) -> float:
+        """Fold one relayed sub-message; returns the tracker-clock ingest
+        stamp for the batch ACK's per-child acks list."""
+        ts = round(time.time(), 6)
+        try:
+            if m.cmd in (P.CMD_START, P.CMD_RECOVER):
+                vconn = _RelayedConn(channel, m.task_id)
+                with self._lock:
+                    self._leases.pop(m.task_id, None)
+                self._register(vconn, m.host, m.task_id, m.listen_port,
+                               m.prev_rank, m.cmd, async_send=True)
+            elif m.cmd == P.CMD_SPARE:
+                self._park_spare(_RelayedConn(channel, m.task_id), m.host,
+                                 m.task_id, m.listen_port, m.prev_rank)
+            elif m.cmd == P.CMD_HEARTBEAT:
+                self._renew_lease(m.task_id, m.prev_rank,
+                                  m.payload.decode())
+            elif m.cmd == P.CMD_METRICS:
+                self._accept_snapshot(m.payload.decode())
+            elif m.cmd == P.CMD_PRINT:
+                self._log_print(m.payload.decode())
+            elif m.cmd == P.CMD_SHUTDOWN:
+                with self._lock:
+                    self._leases.pop(m.task_id, None)
+                self._note_shutdown(m.task_id)
+            elif m.cmd == P.CMD_HANGUP:
+                # The relay saw a parked child's connection EOF: make its
+                # virtual connection read as hung up so the wave purge
+                # drops it (live-survivor counting stays correct through
+                # a relay).
+                vconn = channel.vconns.get(m.task_id)
+                if vconn is not None:
+                    vconn.child_dead = True
+            # CMD_EPOCH never rides a batch (the relay answers polls from
+            # its ack-refreshed cache); CMD_QUORUM and CMD_BLOB are
+            # proxied straight through by the relay (decide-once replies
+            # and rank-0 blob uploads need the synchronous path).
+        except (ValueError, UnicodeDecodeError):
+            pass  # one malformed sub-message must not hurt the batch
+        return ts
 
     @staticmethod
     def _clock_stamp() -> bytes:
@@ -496,23 +1007,37 @@ class Tracker:
         return P.put_str(f"{time.time():.6f}")
 
     def _register(self, conn, host, task_id, listen_port, prev_rank,
-                  cmd=P.CMD_START) -> None:
+                  cmd=P.CMD_START, async_send: bool = False) -> None:
         with self._lock:
             # A re-check-in from the same task id replaces its stale entry
-            # (e.g. worker retried while the wave was still filling).
-            for stale in (p for p in self._pending if p.task_id == task_id):
-                try:
-                    stale.conn.close()
-                except OSError:
-                    pass
-            self._pending = [p for p in self._pending if p.task_id != task_id]
+            # (e.g. worker retried while the wave was still filling).  The
+            # membership test is O(1) — a per-check-in list scan is an
+            # O(world^2) bootstrap at 10^4 workers.
+            if task_id in self._pending_ids:
+                for stale in (p for p in self._pending
+                              if p.task_id == task_id):
+                    try:
+                        stale.conn.close()
+                    except OSError:
+                        pass
+                self._pending = [p for p in self._pending
+                                 if p.task_id != task_id]
+            self._pending_ids.add(task_id)
             self._pending.append(
                 _Pending(conn, task_id, listen_port, host, prev_rank, cmd))
             if self._wave_started is None:
                 self._wave_started = time.monotonic()
             plan = self._close_wave_locked(timer=False)
         if plan is not None:
-            self._send_wave(plan)
+            if async_send:
+                # Reactor / relay-channel callers: an O(world) assignment
+                # broadcast must not stall the accept path or the batch
+                # fold — the completer runs on its own thread.
+                threading.Thread(target=self._send_wave, args=(plan,),
+                                 daemon=True,
+                                 name="rabit-tracker-wave-send").start()
+            else:
+                self._send_wave(plan)
 
     def _park_spare(self, conn, host, task_id, listen_port,
                     prev_rank) -> None:
@@ -657,6 +1182,7 @@ class Tracker:
             except OSError:
                 pass
         self._pending = [p for p in self._pending if p not in dead]
+        self._pending_ids = {p.task_id for p in self._pending}
         self.events.append({
             "ts": round(time.time(), 6), "kind": "wave_purged",
             "dropped": sorted(p.task_id for p in dead),
@@ -730,6 +1256,7 @@ class Tracker:
         members = [self._pending[i] for i in sorted(ordered[:world])]
         surplus = [self._pending[i] for i in sorted(ordered[world:])]
         self._pending = []
+        self._pending_ids = set()
         self._wave_started = None
         # Pool provenance, not take_spares, decides what counts as a
         # promotion: note_dead pre-stages a spare into _pending directly
@@ -821,6 +1348,7 @@ class Tracker:
             sp = self._spares.pop(0)
             sp.cmd = P.CMD_START
             self._pending.append(sp)
+            self._pending_ids.add(sp.task_id)
             if self._wave_started is None:
                 self._wave_started = time.monotonic()
             plan = self._close_wave_locked(timer=True)
@@ -862,24 +1390,38 @@ class Tracker:
                   f"routed around {list(splan.avoided)}"
                   + (f", residual {list(splan.residual)}"
                      if splan.residual else ""), flush=True)
+        # The peer table, rank_map, and schedule frame are identical for
+        # every member: encode that suffix ONCE per wave.  The legacy
+        # serving path keeps the per-member Assignment.encode (the PR 8
+        # behavior the scale sweep measures against) — the bytes are
+        # identical either way (protocol.assignment_tail_bytes).
+        tail = (P.assignment_tail_bytes(peers, plan["epoch"],
+                                        plan["rank_map"], splan.algo,
+                                        list(splan.ring_order))
+                if self._reactor else None)
         for p in plan["members"]:
             rank = plan["rank_map"][p.task_id]
             parent, children = P.tree_topology(rank, world)
-            asg = P.Assignment(
-                rank=rank,
-                world_size=world,
-                parent=parent,
-                children=children,
-                ring_prev=(rank - 1) % world,
-                ring_next=(rank + 1) % world,
-                peers=peers,
-                epoch=plan["epoch"],
-                rank_map=plan["rank_map"],
-                algo=splan.algo,
-                ring_order=list(splan.ring_order),
-            )
+            if tail is not None:
+                payload = P.assignment_head_bytes(
+                    rank, world, parent, children,
+                    (rank - 1) % world, (rank + 1) % world) + tail
+            else:
+                payload = P.Assignment(
+                    rank=rank,
+                    world_size=world,
+                    parent=parent,
+                    children=children,
+                    ring_prev=(rank - 1) % world,
+                    ring_next=(rank + 1) % world,
+                    peers=peers,
+                    epoch=plan["epoch"],
+                    rank_map=plan["rank_map"],
+                    algo=splan.algo,
+                    ring_order=list(splan.ring_order),
+                ).encode()
             try:
-                p.conn.sendall(asg.encode())
+                p.conn.sendall(payload)
             except OSError:
                 pass  # worker died mid-bootstrap; next wave will handle it
             finally:
@@ -1020,6 +1562,8 @@ class Tracker:
             restarts = {t: n - 1 for t, n in self._n_starts.items() if n > 1}
             q_outstanding = ([list(t) for t in self._quorum.outstanding()]
                              if self._quorum is not None else [])
+        with self._stats_lock:
+            serve = dict(self.serve_stats)
         waves = [e for e in events if e["kind"] == "wave"]
         # Per-rank clock-offset estimates (tracker_ts = worker_ts +
         # offset_s), shipped inside snapshots; the trace merger uses these
@@ -1055,6 +1599,15 @@ class Tracker:
             # still-undelivered exclusions at telemetry time, as
             # [src_version, rank, world] — the exact missing mass
             "quorum_outstanding": q_outstanding,
+            # serving-path evidence (doc/scaling.md): reactor/threaded
+            # model, connection and thread high-water marks, relay
+            # batching counts, and worker-print log drops
+            "serving": {"reactor": self._reactor, "backlog": self.backlog,
+                        **serve},
+            "messages_dropped": self.messages_dropped,
+            "n_relays_up": sum(1 for e in events if e["kind"] == "relay_up"),
+            "n_relays_lost": sum(1 for e in events
+                                 if e["kind"] == "relay_lost"),
             "epochs": [{"epoch": we.epoch, "world": we.world_size}
                        for we in self.elastic.history],
             "restarts": restarts,

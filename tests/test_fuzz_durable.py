@@ -123,7 +123,8 @@ def test_fuzzed_whole_job_preemption(seed: int, tmp_path):
     c2 = LocalCluster(sc["world"], max_restarts=0, quiet=True)
     rc = c2.run(cmd, timeout=90.0)
     detail = (f"seed {seed}: {sc}; resume rc={rc} "
-              f"returncodes={c2.returncodes} messages={c2.messages[-6:]}")
+              f"returncodes={c2.returncodes} "
+              f"messages={list(c2.messages)[-6:]}")  # bounded deque
     assert rc == 0 and all(r == 0 for r in c2.returncodes.values()), detail
     verified = sum(f"all {sc['niter']} iterations verified" in m
                    for m in c2.messages)

@@ -1,0 +1,539 @@
+"""Control-plane fan-out (ISSUE 9, doc/scaling.md): the event-loop
+tracker, the hierarchical relay tier, and batched liveness.
+
+Layers covered, bottom-up:
+
+* wire units: CMD_BATCH envelope and route-frame round-trips, the
+  incremental hello parser (byte-at-a-time feeds, bad magic, pipelined
+  rest), and the shared head/tail Assignment encoding proven byte-equal
+  to ``Assignment.encode``;
+* reactor vs threaded A/B: identical reply bytes for every short RPC,
+  identical Assignment bytes for the same scripted wave, identical
+  job outcomes (telemetry event kinds, bitwise worker states) for the
+  same in-thread elastic job;
+* the bounded worker-print log (capped deque + ``messages_dropped``
+  counter/event/telemetry) and the ``rabit_tracker_backlog`` config key;
+* relay e2e: bootstrap + heartbeats + metrics through a relay (tracker
+  accepts O(relays) connections), clock projection through the batch
+  ACK bracket, a mock-killed child recovering through the relay at
+  process level (``LocalCluster(relays=...)``);
+* chaos: seeded relay-death (bounce) and relay-partition campaigns
+  through ``run_elastic_schedule(relays=...)`` — heal-then-converge,
+  and child leases surviving a bounce with zero spurious
+  ``lease_expired`` kills;
+* the ``--scale-sweep`` smoke at world 256: all three serving arms
+  complete their waves; the relayed root accepts O(relays) connections
+  while the direct arms accept O(world).
+"""
+
+import json
+import socket
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from rabit_tpu.chaos import FaultSpec, run_elastic_schedule
+from rabit_tpu.elastic.client import ElasticWorker
+from rabit_tpu.elastic.rebalance import shard_slice
+from rabit_tpu.relay import RELAY_LEASE_PAD, Relay
+from rabit_tpu.tracker import protocol as P
+from rabit_tpu.tracker.tracker import Tracker
+
+
+# -- wire units ---------------------------------------------------------------
+
+def test_batch_frame_round_trip():
+    msgs = [
+        P.BatchMsg("7", P.CMD_START, -1, "10.0.0.7", 40007, b"", 1.25),
+        P.BatchMsg("3", P.CMD_HEARTBEAT, 3, "", 0, b"0.500000", 2.5),
+        P.BatchMsg("9", P.CMD_METRICS, 9, "", 0, b'{"rank": 9}', 3.75),
+        P.BatchMsg("s1", P.CMD_SPARE, -1, "10.0.0.8", 40008, b"", 4.0),
+        P.BatchMsg("2", P.CMD_HANGUP, -1, "", 0, b"", 5.0),
+    ]
+    a, b = socket.socketpair()
+    try:
+        a.sendall(P.put_batch_frame(msgs))
+        got = P.read_batch_frame(b)
+    finally:
+        a.close()
+        b.close()
+    assert got == msgs
+
+
+def test_route_frame_round_trip():
+    a, b = socket.socketpair()
+    try:
+        a.sendall(P.put_route_frame("task9", P.ROUTE_CLOSE, b"payload"))
+        a.sendall(P.put_route_frame("", 0, b'{"server_ts": 1.0}'))
+        assert P.read_route_frame(b) == ("task9", P.ROUTE_CLOSE, b"payload")
+        assert P.read_route_frame(b) == ("", 0, b'{"server_ts": 1.0}')
+    finally:
+        a.close()
+        b.close()
+
+
+@pytest.mark.parametrize("chunk", [1, 3, 1000])
+def test_hello_parser_incremental(chunk):
+    raw = b"".join([P.put_u32(P.MAGIC_HELLO), P.put_u32(P.CMD_HEARTBEAT),
+                    P.put_i32(4), P.put_str("task4"), P.put_str("0.25")])
+    sp = P.StreamParser(P.hello_parser())
+    done = False
+    for i in range(0, len(raw), chunk):
+        done = sp.feed(raw[i:i + chunk])
+    assert done and sp.done
+    h = sp.result
+    assert (h.cmd, h.prev_rank, h.task_id, h.message) == (
+        P.CMD_HEARTBEAT, 4, "task4", "0.25")
+    assert sp.rest() == b""
+
+
+def test_hello_parser_shapes_and_rest():
+    # wave hello carries a listen port
+    raw = b"".join([P.put_u32(P.MAGIC_HELLO), P.put_u32(P.CMD_START),
+                    P.put_i32(-1), P.put_str("0"), P.put_u32(40000)])
+    sp = P.StreamParser(P.hello_parser())
+    assert sp.feed(raw + b"PIPELINED")
+    assert sp.result.listen_port == 40000
+    assert sp.rest() == b"PIPELINED"
+    # blob hello carries version + payload bytes
+    raw = b"".join([P.put_u32(P.MAGIC_HELLO), P.put_u32(P.CMD_BLOB),
+                    P.put_i32(0), P.put_str("0"), P.put_u32(3),
+                    P.put_u32(5), b"hello"])
+    sp = P.StreamParser(P.hello_parser())
+    assert sp.feed(raw)
+    assert (sp.result.blob_version, sp.result.blob) == (3, b"hello")
+    # bad magic raises at feed time
+    sp = P.StreamParser(P.hello_parser())
+    with pytest.raises(ValueError):
+        sp.feed(P.put_u32(0xDEAD) + b"\x00" * 16)
+
+
+def test_assignment_head_tail_equals_encode():
+    asg = P.Assignment(
+        rank=2, world_size=5, parent=0, children=[5, 6][:1],
+        ring_prev=1, ring_next=3,
+        peers={r: ("127.0.0.1", 40000 + r) for r in range(5)},
+        epoch=7, rank_map={str(i): i for i in range(5)},
+        algo="swing", ring_order=[0, 2, 4, 3, 1])
+    split = (P.assignment_head_bytes(2, 5, 0, asg.children, 1, 3)
+             + P.assignment_tail_bytes(asg.peers, 7, asg.rank_map,
+                                       "swing", asg.ring_order))
+    assert split == asg.encode()
+
+
+# -- reactor vs threaded A/B --------------------------------------------------
+
+def _rpc_bytes(addr, cmd, task_id, message="", listen_port=0,
+               prev_rank=-1):
+    """One raw RPC: hello out, every reply byte back (until EOF)."""
+    with socket.create_connection(addr, timeout=5.0) as sock:
+        sock.settimeout(5.0)
+        P.send_hello(sock, cmd, task_id, prev_rank=prev_rank,
+                     listen_port=listen_port, message=message)
+        out = b""
+        while True:
+            try:
+                chunk = sock.recv(4096)
+            except socket.timeout:
+                break
+            if not chunk:
+                break
+            out += chunk
+    return out
+
+
+def test_reactor_threaded_reply_bytes_identical():
+    """Acceptance: with --relays 0 the wire bytes an existing worker sees
+    are identical on both serving paths (clock stamps compared by shape,
+    not value)."""
+    trackers = [Tracker(2, quiet=True, reactor=r).start()
+                for r in (True, False)]
+    try:
+        replies = {}
+        for tr in trackers:
+            addr = (tr.host, tr.port)
+            replies[tr._reactor] = [
+                _rpc_bytes(addr, P.CMD_PRINT, "0", message="hello world"),
+                _rpc_bytes(addr, P.CMD_EPOCH, "0", message="3"),
+                _rpc_bytes(addr, P.CMD_BLOB, "0"),
+                _rpc_bytes(addr, P.CMD_QUORUM, "0",
+                           message='{"epoch": 0, "v": 1, "have": [0]}'),
+            ]
+        assert replies[True] == replies[False]
+        # timestamped replies: identical ACK prefix + stamp SHAPE
+        for tr in trackers:
+            raw = _rpc_bytes((tr.host, tr.port), P.CMD_HEARTBEAT, "0",
+                             message="5.0")
+            assert raw[:4] == P.put_u32(P.ACK)
+            float(raw[8:].decode())  # u32 strlen + decimal stamp
+    finally:
+        for tr in trackers:
+            tr.stop()
+
+
+def _scripted_wave(tr) -> dict[str, bytes]:
+    """Two scripted check-ins; returns task -> raw Assignment bytes."""
+    out: dict[str, bytes] = {}
+
+    def checkin(tid: str, port: int) -> None:
+        out[tid] = _rpc_bytes((tr.host, tr.port), P.CMD_START, tid,
+                              listen_port=port)
+
+    threads = [threading.Thread(target=checkin, args=(t, p), daemon=True)
+               for t, p in (("0", 41000), ("1", 41001))]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(timeout=10.0)
+        assert not th.is_alive()
+    return out
+
+
+def test_reactor_threaded_assignment_bytes_identical():
+    waves = {}
+    for reactor in (True, False):
+        tr = Tracker(2, quiet=True, reactor=reactor).start()
+        try:
+            waves[reactor] = _scripted_wave(tr)
+        finally:
+            tr.stop()
+    assert waves[True] == waves[False]
+    assert len(waves[True]["0"]) > 20  # a real assignment, not an EOF
+
+
+def _run_job(reactor: bool, world: int = 3, niter: int = 3):
+    data = (np.arange(8 * world, dtype=np.int64) * 5) % 16
+
+    def contribution(v, w, r):
+        rows = data[shard_slice(len(data), w, r)]
+        return np.bincount(rows, minlength=16).astype(np.int64) * v
+
+    tr = Tracker(world, quiet=True, reactor=reactor).start()
+    results = {}
+
+    def run(w):
+        results[w.task_id] = w.run()
+
+    workers = [ElasticWorker((tr.host, tr.port), str(i), contribution,
+                             niter, heartbeat_sec=0.1, wave_timeout=10.0,
+                             link_timeout=5.0, deadline_sec=30.0)
+               for i in range(world)]
+    threads = [threading.Thread(target=run, args=(w,), daemon=True)
+               for w in workers]
+    try:
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=25.0)
+            assert not th.is_alive(), "worker hung"
+    finally:
+        tr.stop()
+    assert tr.wait(5.0)
+    return results, tr.telemetry
+
+
+def test_reactor_threaded_job_equivalent():
+    """The same elastic job through both serving paths: bitwise-equal
+    worker states and the same telemetry event-kind tallies (timestamps
+    aside, the threaded and reactor trackers must tell the same story)."""
+    out = {r: _run_job(r) for r in (True, False)}
+    res_r, tel_r = out[True]
+    res_t, tel_t = out[False]
+    for tid in res_r:
+        assert res_r[tid].completed and res_t[tid].completed
+        assert np.array_equal(res_r[tid].state, res_t[tid].state)
+    for key in ("n_waves", "n_recovery_waves", "n_lease_expired",
+                "world_size", "messages_dropped"):
+        assert tel_r[key] == tel_t[key], key
+    kinds_r = sorted(e["kind"] for e in tel_r["events"])
+    kinds_t = sorted(e["kind"] for e in tel_t["events"])
+    assert kinds_r == kinds_t
+    assert tel_r["serving"]["reactor"] and not tel_t["serving"]["reactor"]
+    assert tel_t["serving"]["handler_threads_hwm"] >= 1
+    assert tel_r["serving"]["handler_threads_hwm"] == 0
+
+
+# -- bounded worker-print log + backlog config --------------------------------
+
+def test_messages_bounded_with_drop_counter():
+    tr = Tracker(2, quiet=True, max_messages=4)
+    for i in range(10):
+        tr._log_print(f"msg {i}")
+    assert list(tr.messages) == [f"msg {i}" for i in range(6, 10)]
+    assert tr.messages_dropped == 6
+    dropped_events = [e for e in tr.events
+                      if e["kind"] == "messages_dropped"]
+    assert len(dropped_events) == 1 and dropped_events[0]["cap"] == 4
+    tel = tr.build_telemetry()
+    assert tel["messages_dropped"] == 6
+    tr.stop()
+
+
+def test_backlog_config_key(monkeypatch):
+    tr = Tracker(2, quiet=True)
+    assert tr.backlog == 1024  # the DEFAULTS value
+    tr.stop()
+    monkeypatch.setenv("RABIT_TPU_RABIT_TRACKER_BACKLOG", "64")
+    tr = Tracker(2, quiet=True)
+    assert tr.backlog == 64
+    tr.stop()
+    tr = Tracker(2, quiet=True, backlog=256)  # explicit arg wins
+    assert tr.backlog == 256
+    tr.stop()
+
+
+# -- relay e2e ----------------------------------------------------------------
+
+def _hist_job(world, niter, addr_of, heartbeat_sec=0.2, deadline=40.0,
+              fail=None):
+    data = (np.arange(8 * world, dtype=np.int64) * 3) % 8
+
+    def contribution(v, w, r):
+        rows = data[shard_slice(len(data), w, r)]
+        return np.bincount(rows, minlength=8).astype(np.int64) * v
+
+    expected = sum(np.bincount(data, minlength=8).astype(np.int64) * v
+                   for v in range(1, niter + 1))
+    results = {}
+    lock = threading.Lock()
+
+    def run(w):
+        res = w.run()
+        with lock:
+            results[w.task_id] = res
+
+    workers = [ElasticWorker(addr_of(i), str(i), contribution, niter,
+                             heartbeat_sec=heartbeat_sec,
+                             wave_timeout=10.0, link_timeout=5.0,
+                             deadline_sec=deadline,
+                             fail=(fail if str(i) == "1" else None))
+               for i in range(world)]
+    threads = [threading.Thread(target=run, args=(w,), daemon=True)
+               for w in workers]
+    return workers, threads, results, expected, contribution
+
+
+def test_relay_e2e_bootstrap_heartbeat_metrics():
+    """Bootstrap + liveness + blob traffic through one relay: the job
+    completes bitwise-correct, the root accepted O(1) connections, the
+    batch envelope carried the liveness, and the relay's child ACK
+    stamps project the TRACKER clock."""
+    tr = Tracker(3, quiet=True).start()
+    relay = Relay((tr.host, tr.port), relay_id="rT", flush_sec=0.1).start()
+    addr = (relay.host, relay.port)
+    try:
+        _, threads, results, expected, _ = _hist_job(
+            3, 3, lambda i: addr)
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=30.0)
+            assert not th.is_alive()
+        for tid, res in results.items():
+            assert res.completed, (tid, res.error)
+            assert np.array_equal(res.state, expected)
+        assert tr.wait(8.0)
+        tel = tr.telemetry
+        assert tel["n_relays_up"] == 1
+        assert tel["serving"]["batches"] >= 1
+        assert tel["serving"]["batch_msgs"] >= 3   # liveness rode batches
+        # one channel + rank-0 blob proxies — never O(world) per RPC
+        assert tel["serving"]["accepts"] <= 8
+        assert tel["n_lease_expired"] == 0
+        # the relay calibrated a tracker-clock projection
+        assert relay.clock_err < 0.5
+        reply = P.tracker_rpc(relay.host, relay.port, P.CMD_HEARTBEAT,
+                              "probe", message="5.0")
+        assert abs(reply.server_ts - time.time()) < 1.0
+    finally:
+        relay.stop()
+        tr.stop()
+
+
+def test_relay_child_death_reported_and_recovered():
+    """A child dying mid-job behind a relay: peers recover through a
+    wave, a fresh life of the same task re-enters THROUGH THE RELAY, and
+    the job converges bitwise-correct (the launcher restart shape, in
+    threads)."""
+    world, niter = 3, 4
+    tr = Tracker(world, quiet=True).start()
+    relay = Relay((tr.host, tr.port), relay_id="rR",
+                  flush_sec=0.1).start()
+    addr = (relay.host, relay.port)
+    try:
+        workers, threads, results, expected, contribution = _hist_job(
+            world, niter, lambda i: addr, fail=("die", 2))
+        for th in threads:
+            th.start()
+        # wait for the injected death, then restart task 1 through the
+        # relay (same task id -> stable rank re-admission)
+        deadline = time.monotonic() + 20.0
+        while "1" not in results and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert results.get("1") is not None and results["1"].died
+        restarted = ElasticWorker(addr, "1", contribution, niter,
+                                  heartbeat_sec=0.2, wave_timeout=10.0,
+                                  link_timeout=5.0, deadline_sec=30.0)
+        restart_res = {}
+        th = threading.Thread(
+            target=lambda: restart_res.update(r1=restarted.run()),
+            daemon=True)
+        th.start()
+        for t in threads:
+            t.join(timeout=30.0)
+            assert not t.is_alive()
+        th.join(timeout=30.0)
+        assert not th.is_alive()
+        assert restart_res["r1"].completed, restart_res["r1"].error
+        assert np.array_equal(restart_res["r1"].state, expected)
+        for tid in ("0", "2"):
+            assert results[tid].completed
+            assert np.array_equal(results[tid].state, expected)
+    finally:
+        relay.stop()
+        tr.stop()
+
+
+def test_relay_cluster_process_level():
+    """LocalCluster --relays: real worker processes, a mock-killed rank
+    recovering through the relay tier, O(relays) root accepts."""
+    from rabit_tpu.tracker.launcher import LocalCluster, cpu_worker_env
+
+    cluster = LocalCluster(3, max_restarts=3, quiet=True,
+                           extra_env=cpu_worker_env(), relays=2)
+    rc = cluster.run(
+        [sys.executable, "tests/workers/recover_worker.py",
+         "rabit_engine=mock", "ndata=500", "niter=3", "mock=1,1,1,0"],
+        timeout=120.0)
+    assert rc == 0
+    assert all(r == 0 for r in cluster.returncodes.values())
+    tel = cluster.telemetry
+    assert tel["n_relays_up"] == 2
+    assert tel["n_recovery_waves"] >= 1
+    assert tel["serving"]["accepts"] <= 4  # 2 channels (+ reconnects)
+    assert sum(1 for e in cluster.events
+               if e["kind"] == "worker_recovered") >= 1
+
+
+# -- chaos: relay bounce / partition -----------------------------------------
+
+def test_relay_bounce_leases_survive():
+    """The satellite's named assert: a relay bounce is NOT a membership
+    event — child leases survive without a spurious lease_expired kill
+    (the padded upstream interval covers the gap)."""
+    r = run_elastic_schedule(
+        7101, world=3, relays=2, heartbeat_sec=0.3, niter=8,
+        iter_sleep=0.15, deadline_sec=60.0,
+        relay_fault=FaultSpec(relay_death=(0.8, 0.4)))
+    assert r.outcome == "completed"
+    assert r.n_spurious_expired == 0
+    assert r.n_relay_lost >= 1  # the bounce was actually delivered
+
+
+def test_relay_partition_heals_and_converges():
+    r = run_elastic_schedule(
+        7102, world=3, relays=2, heartbeat_sec=0.3, niter=8,
+        iter_sleep=0.15, deadline_sec=60.0,
+        relay_fault=FaultSpec(relay_partition=(0.6, 0.5)))
+    assert r.outcome == "completed"
+    assert r.n_spurious_expired == 0
+
+
+def test_relay_fuzz_fast_campaign():
+    """Seeded relayed shrink/grow schedules, bounce and partition mixed
+    in: heal-then-converge with the full bitwise asserts of
+    run_elastic_schedule, zero spurious expiries throughout."""
+    faults = [None,
+              FaultSpec(relay_death=(0.6, 0.3)),
+              FaultSpec(relay_partition=(0.5, 0.4))]
+    for i, seed in enumerate(range(7200, 7206)):
+        r = run_elastic_schedule(
+            seed, relays=2, heartbeat_sec=0.3, deadline_sec=60.0,
+            relay_fault=faults[i % len(faults)])
+        assert r.outcome == "completed", seed
+        assert r.n_spurious_expired == 0, seed
+        assert r.relays == 2
+
+
+@pytest.mark.slow
+def test_relay_fuzz_full_campaign():
+    faults = [None,
+              FaultSpec(relay_death=(0.6, 0.3)),
+              FaultSpec(relay_death=(1.2, 0.5)),
+              FaultSpec(relay_partition=(0.5, 0.4)),
+              FaultSpec(relay_death=(0.4, 0.3),
+                        relay_partition=(1.5, 0.4))]
+    for i, seed in enumerate(range(7300, 7320)):
+        r = run_elastic_schedule(
+            seed, relays=(1 + i % 3), heartbeat_sec=0.3,
+            deadline_sec=75.0, relay_fault=faults[i % len(faults)])
+        assert r.outcome == "completed", seed
+        assert r.n_spurious_expired == 0, seed
+
+
+# -- scale sweep smoke --------------------------------------------------------
+
+def test_scale_sweep_smoke_world_256():
+    """Tier-1 shape of the ISSUE 9 acceptance sweep: world 256, all
+    three serving arms complete bootstrap AND recovery waves; the
+    relayed root accepts O(relays) connections while direct arms accept
+    O(world); liveness holds with zero false lease expiries on the
+    reactor paths."""
+    from tools.scale_sweep import scale_sweep
+
+    recs = {r["arm"]: r for r in scale_sweep(
+        [256], hb_interval=0.4, hb_beats=2, deadline_sec=60.0,
+        relays_for=lambda w: 2, emit=None)}
+    assert set(recs) == {"threaded_direct", "reactor_direct", "relayed"}
+    for arm, rec in recs.items():
+        assert rec["bootstrap"]["wave_completed"] == 256, arm
+        assert rec["recovery"]["wave_completed"] == 256, arm
+        assert rec["liveness"]["rpc_p99_ms"] is not None, arm
+    assert recs["relayed"]["tracker"]["accepts"] <= 8
+    assert recs["threaded_direct"]["tracker"]["accepts"] >= 256
+    assert recs["reactor_direct"]["tracker"]["accepts"] >= 256
+    assert recs["threaded_direct"]["tracker"]["handler_threads_hwm"] >= 1
+    assert recs["reactor_direct"]["tracker"]["handler_threads_hwm"] == 0
+    for arm in ("reactor_direct", "relayed"):
+        assert recs[arm]["lease_expired"] == 0, arm
+    assert recs["relayed"]["snapshots"] == 256  # metrics ingested via
+    #                                             coalesced batches
+
+
+# -- relay internals ----------------------------------------------------------
+
+def test_relayed_conn_reads_dead_on_channel_loss():
+    """The tracker's _conn_dead peek must see a dead relay channel (or a
+    reported child hangup) as EOF so purge/reap clean relayed pendings."""
+    from rabit_tpu.tracker.tracker import (_RelayChannel, _RelayedConn,
+                                           _conn_dead)
+
+    a, b = socket.socketpair()
+    try:
+        ch = _RelayChannel(a, "rX")
+        vconn = _RelayedConn(ch, "5")
+        assert not _conn_dead(vconn)       # open and idle
+        vconn.sendall(b"probe")            # routes a frame
+        assert P.read_route_frame(b)[0] == "5"
+        ch.vconns["5"].child_dead = True   # a CMD_HANGUP fold
+        assert _conn_dead(vconn)
+        vconn2 = _RelayedConn(ch, "6")
+        ch.close()
+        assert _conn_dead(vconn2)          # dead channel == EOF
+        with pytest.raises(OSError):
+            vconn2.sendall(b"late")
+    finally:
+        a.close()
+        b.close()
+
+
+def test_relay_lease_padding_math():
+    """The bounce-survival contract: upstream interval is padded so the
+    root lease (LEASE_FACTOR x padded) covers at least one whole missed
+    flush."""
+    child_interval, flush = 0.2, 0.25
+    padded = max(child_interval, flush) * RELAY_LEASE_PAD
+    assert padded * P.LEASE_FACTOR >= 2 * flush + child_interval

@@ -29,6 +29,13 @@ rounds/sec with an injected compute straggler, quorum off vs on vs
 on+i8 — quorum off gates every round on the tail, quorum on must track
 the median worker (within 1.3x of the no-straggler baseline).
 
+PR 9 adds ``--scale-sweep`` (doc/scaling.md, tools/scale_sweep.py):
+simulated worlds at 512-8192 measuring bootstrap/recovery-wave latency,
+heartbeat/metrics RPC p99, and tracker FD/thread high-water marks for
+the thread-per-connection, reactor, and relayed serving paths
+(``--scale-worlds`` picks the curve; the RESULTS §3e anchor is the full
+run in RESULTS/scale_sweep.jsonl).
+
 Usage:  python tools/consensus_bench.py [--world 32] [--iters 200]
 Prints one JSON line per mode; the default latency mode runs as
 __main__ only (spawns a local cluster).
@@ -340,6 +347,13 @@ def main() -> None:
     ap.add_argument("--quorum-ablation", action="store_true",
                     help="rounds/sec vs an injected straggler: quorum "
                          "off/on/on+i8 (doc/partial_allreduce.md)")
+    ap.add_argument("--scale-sweep", action="store_true",
+                    help="simulated-world control-plane sweep: direct "
+                         "threaded vs reactor vs relayed serving "
+                         "(doc/scaling.md)")
+    ap.add_argument("--scale-worlds", type=int, nargs="*",
+                    default=[512, 1024, 2048, 4096],
+                    help="worlds for --scale-sweep")
     ap.add_argument("--quorum", default="0.6",
                     help="rabit_quorum spec for --quorum-ablation")
     ap.add_argument("--quorum-niter", type=int, default=40)
@@ -366,6 +380,11 @@ def main() -> None:
         print(json.dumps(quorum_ablation(
             niter=args.quorum_niter, quorum=args.quorum,
             straggler_factor=args.straggler_factor)), flush=True)
+        return
+    if args.scale_sweep:
+        from tools.scale_sweep import scale_sweep
+
+        scale_sweep(args.scale_worlds)
         return
     results = {}
     for on in (True, False):

@@ -20,6 +20,12 @@ structured tracker events (``spare_promoted`` / ``world_shrunk`` /
 lines under ``"elastic"`` in the bench record (bench.py), so the BENCH
 trajectory picks them up.
 
+``--scale-sweep`` switches to the simulated-world control-plane sweep
+(tools/scale_sweep.py, doc/scaling.md): recovery-wave latency under
+heartbeat load at worlds 512-8192, thread-per-connection vs reactor vs
+relayed — the recovery half of the RESULTS §3e curve (bootstrap rides
+along; ``tools/consensus_bench.py --scale-sweep`` is the same sweep).
+
 ``--blob-mb B [B ...]`` switches to the checkpoint-serve-scaling mode
 (round-5 verdict #3): the worker carries a B-MiB content-verified blob in
 its global model, so the restarted rank's recovery streams a realistic
@@ -357,8 +363,16 @@ def main() -> None:
                          "(doc/elasticity.md)")
     ap.add_argument("--shrink-after", type=float, default=1.0,
                     help="elastic mode's rabit_shrink_after_sec")
+    ap.add_argument("--scale-sweep", action="store_true",
+                    help="simulated-world recovery/bootstrap wave sweep "
+                         "(doc/scaling.md; worlds from the positional "
+                         "args, default 512 1024 2048 4096)")
     args = ap.parse_args()
-    if args.elastic:
+    if args.scale_sweep:
+        from tools.scale_sweep import scale_sweep
+
+        scale_sweep(args.worlds or [512, 1024, 2048, 4096])
+    elif args.elastic:
         elastic_sweep(args.worlds or [2, 4], args.shrink_after)
     elif args.resume:
         resume_sweep(args.blob_mb or [0.0], args.worlds or [4])
