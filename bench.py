@@ -103,6 +103,13 @@ QUORUM_CHILD_TIMEOUT = 180.0
 SCALE_BENCH = os.environ.get("RABIT_BENCH_SCALE", "1") != "0"
 SCALE_CHILD_TIMEOUT = 240.0
 SCALE_WORLDS = os.environ.get("RABIT_BENCH_SCALE_WORLDS", "512 1024")
+# HA failover (ISSUE 10): primary-tracker kill -> standby takeover /
+# first post-failover wave latency, direct and relayed
+# (tools/recovery_bench.py --failover; doc/ha.md) in a CPU child;
+# deducted from the TPU budget like the other riders; RABIT_BENCH_HA=0
+# skips it.
+HA_BENCH = os.environ.get("RABIT_BENCH_HA", "1") != "0"
+HA_CHILD_TIMEOUT = 180.0
 
 
 def log(msg):
@@ -485,6 +492,35 @@ def run_scale_bench(timeout=SCALE_CHILD_TIMEOUT):
     return lines
 
 
+def run_ha_bench(timeout=HA_CHILD_TIMEOUT):
+    """HA failover records (tools/recovery_bench.py --failover) in a
+    child: in-thread elastic workers + a warm standby + an abrupt
+    primary kill (threads + sleeps; a child so a wedged run cannot
+    stall the driver).  Returns the record list, empty on
+    timeout/failure."""
+    cmd = [sys.executable,
+           os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "tools", "recovery_bench.py"),
+           "--failover", "2", "4"]
+    lines = []
+    try:
+        r = subprocess.run(cmd, timeout=timeout, capture_output=True,
+                           text=True)
+        if r.returncode == 0:
+            for line in r.stdout.strip().splitlines():
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if isinstance(rec, dict) and rec.get("mode") == "ha_failover":
+                    lines.append(rec)
+        else:
+            log(f"ha failover child rc={r.returncode}")
+    except subprocess.TimeoutExpired:
+        log(f"ha failover child timed out after {timeout:.0f}s")
+    return lines
+
+
 def probe_device(timeout=45.0) -> bool:
     """Fast TPU liveness check in a throwaway child: a wedged axon tunnel
     hangs at backend init (holding jax's lock forever), and burning the
@@ -665,6 +701,14 @@ def main():
                          min(tpu_budget, 300.0))
         log(f"scale sweep: {len(scale_lines)} line(s); "
             f"TPU budget now {tpu_budget:.0f}s")
+    ha_lines = []
+    if HA_BENCH:
+        t_ha = time.time()
+        ha_lines = run_ha_bench()
+        tpu_budget = max(tpu_budget - (time.time() - t_ha),
+                         min(tpu_budget, 300.0))
+        log(f"ha failover bench: {len(ha_lines)} line(s); "
+            f"TPU budget now {tpu_budget:.0f}s")
     res = try_tpu_within_budget(tpu_budget)
     n_rows = N_ROWS
     if not isinstance(res, dict):
@@ -698,6 +742,8 @@ def main():
             rec["quorum_ablation"] = quorum_lines
         if scale_lines:
             rec["scale_sweep"] = scale_lines
+        if ha_lines:
+            rec["ha_failover"] = ha_lines
         print(json.dumps(rec), flush=True)
         return
     device_time = res["device_time"]
@@ -747,6 +793,8 @@ def main():
         rec["quorum_ablation"] = quorum_lines
     if scale_lines:
         rec["scale_sweep"] = scale_lines
+    if ha_lines:
+        rec["ha_failover"] = ha_lines
     print(json.dumps(rec), flush=True)
 
 

@@ -96,6 +96,17 @@ class FaultSpec:
     #: the split-coordination-tier shape (batches resume at heal).
     relay_death: tuple[float, float] | None = None
     relay_partition: tuple[float, float] | None = None
+    #: HA control-plane faults (doc/ha.md; consumed by
+    #: :func:`run_elastic_schedule` ``failover=`` mode, not by the
+    #: proxy): ``tracker_death=at_s`` SIGKILLs the PRIMARY tracker
+    #: ``at_s`` seconds into the run (``Tracker.kill()`` — every socket
+    #: drops with no goodbye), wherever the job happens to be:
+    #: mid-bootstrap-wave, mid-quorum-round, mid-shrink-wave.  The warm
+    #: standby must take over within its lease and the job must
+    #: converge bitwise-identically.  ``standby_death=at_s`` kills the
+    #: STANDBY instead — the job must ride on the primary, unbothered.
+    tracker_death: float | None = None
+    standby_death: float | None = None
 
     def clear(self) -> "FaultSpec":
         return FaultSpec()
@@ -526,6 +537,11 @@ class ElasticScheduleResult:
     n_batches_folded: int = 0         # non-empty CMD_BATCH envelopes folded
     n_spurious_expired: int = 0       # lease_expired for tasks that never
     #                                   died (must stay 0 across a bounce)
+    # HA failover runs (rabit_tpu.ha, doc/ha.md)
+    standby: bool = False             # a warm standby rode along
+    n_failover: int = 0               # tracker_failover promotions
+    n_journal_gap: int = 0            # replay divergences (must stay 0)
+    primary_killed: bool = False      # the tracker_death fault landed
 
 
 def run_elastic_schedule(seed: int, world: int | None = None,
@@ -545,7 +561,9 @@ def run_elastic_schedule(seed: int, world: int | None = None,
                          relays: int = 0,
                          relay_fault: FaultSpec | None = None,
                          relay_flush: float = 0.1,
-                         heartbeat_sec: float = 0.15) -> ElasticScheduleResult:
+                         heartbeat_sec: float = 0.15,
+                         failover: FaultSpec | None = None,
+                         takeover_sec: float = 0.5) -> ElasticScheduleResult:
     """One fuzzed shrink/grow scenario (deterministic per seed).
 
     A seeded mix of elastic failure shapes against a real elastic tracker:
@@ -605,6 +623,19 @@ def run_elastic_schedule(seed: int, world: int | None = None,
     ``lease_expired`` (the padded upstream lease must ride out a bounce)
     and that a relay death never shows up as a membership event of its
     children.
+
+    ``failover=FaultSpec(tracker_death=at_s)`` arms the HA arm
+    (doc/ha.md): the tracker journals every mutation (in-memory
+    journal), a warm :class:`rabit_tpu.ha.Standby` streams it over
+    CMD_JOURNAL, workers (and relays, when ``relays>0``) carry the
+    two-entry address list — and at ``at_s`` the primary is killed
+    ABRUPTLY (``Tracker.kill()``), wherever the job is: mid-bootstrap,
+    mid-quorum-round, mid-shrink.  The standby must promote within its
+    takeover lease, the interrupted wave must re-complete on it, live
+    ranks must not suffer a spurious ``lease_expired``, and every
+    bitwise assert below applies unchanged across the merged
+    primary+standby event timeline.  ``standby_death=at_s`` kills the
+    standby instead — the job must ride the primary, unbothered.
 
     Quorum correctness asserts: every completed worker's final state is
     BITWISE IDENTICAL; with a single epoch the state equals the closed
@@ -679,12 +710,27 @@ def run_elastic_schedule(seed: int, world: int | None = None,
     # only after link_timeout, and a shorter shrink deadline would close
     # the wave without it — splitting the job (doc/elasticity.md, "Choosing
     # the knobs").
-    tracker = Tracker(world, quiet=quiet, conn_timeout_sec=1.0,
-                      shrink_after_sec=1.5, promote_after_sec=0.1,
-                      schedule=schedule, sched_repair=repair,
-                      quorum=quorum,
-                      quorum_flag_after=quorum_flag_after).start()
+    tracker_kwargs = dict(quiet=quiet, conn_timeout_sec=1.0,
+                          shrink_after_sec=1.5, promote_after_sec=0.1,
+                          schedule=schedule, sched_repair=repair,
+                          quorum=quorum,
+                          quorum_flag_after=quorum_flag_after)
+    journal = None
+    standby = None
+    if failover is not None:
+        from rabit_tpu.ha import Journal
+
+        journal = Journal(None)  # in-memory: the CMD_JOURNAL stream syncs
+    tracker = Tracker(world, journal=journal, **tracker_kwargs).start()
     addr = (tracker.host, tracker.port)
+    worker_addrs: list = [addr]
+    if failover is not None:
+        from rabit_tpu.ha import Standby
+
+        standby = Standby(primary=addr, takeover_sec=takeover_sec,
+                          poll_sec=0.05, quiet=quiet,
+                          tracker_kwargs=tracker_kwargs).start()
+        worker_addrs.append((standby.host, standby.port))
     # Relay tier (doc/scaling.md): workers shard round-robin across R
     # in-process relays; relay 0 is the fault target.
     relay_objs: list = []
@@ -692,13 +738,15 @@ def run_elastic_schedule(seed: int, world: int | None = None,
     if relays > 0:
         from rabit_tpu.relay import Relay
 
-        relay_objs = [Relay(addr, relay_id=f"relay{i}",
+        # relays carry the full failover list: children never re-dial
+        # across a root failover, the relay channel rotates for them
+        relay_objs = [Relay(worker_addrs, relay_id=f"relay{i}",
                             flush_sec=relay_flush, quiet=True).start()
                       for i in range(int(relays))]
 
-    def task_addr(tid: str) -> tuple[str, int]:
+    def task_addr(tid: str):
         if not relay_objs:
-            return addr
+            return worker_addrs if len(worker_addrs) > 1 else addr
         try:
             idx = int(tid.lstrip("s"))
         except ValueError:
@@ -750,6 +798,27 @@ def run_elastic_schedule(seed: int, world: int | None = None,
                                                   daemon=True))
         if relay_fault.relay_partition is not None:
             fault_threads.append(threading.Thread(target=partition_relay,
+                                                  daemon=True))
+    if failover is not None:
+        # HA faults (doc/ha.md): SIGKILL the primary (or the standby)
+        # wherever the job happens to be.  Tracker.kill() drops every
+        # socket with no goodbye — parked waves, spare pool, relay and
+        # journal channels — exactly the preempted-VM shape.
+        def kill_primary() -> None:
+            if stop_fault.wait(failover.tracker_death):
+                return
+            tracker.kill()
+
+        def kill_standby() -> None:
+            if stop_fault.wait(failover.standby_death):
+                return
+            standby.kill()
+
+        if failover.tracker_death is not None:
+            fault_threads.append(threading.Thread(target=kill_primary,
+                                                  daemon=True))
+        if failover.standby_death is not None:
+            fault_threads.append(threading.Thread(target=kill_standby,
                                                   daemon=True))
     t0 = time.monotonic()
     results: dict[str, object] = {}
@@ -844,6 +913,12 @@ def run_elastic_schedule(seed: int, world: int | None = None,
         # stop loop ran and leak it.
         for th in fault_threads:
             th.join(timeout=8.0)
+        # The standby after the faults settle: if it promoted, it IS the
+        # job's tracker and its stop() tears that tracker down too; if
+        # not, stop() just ends the tail loop (before its takeover lease
+        # could fire against the deliberately-stopped primary).
+        if standby is not None:
+            standby.stop()
         with relay_lock:
             for r in relay_objs:
                 r.stop()
@@ -861,6 +936,20 @@ def run_elastic_schedule(seed: int, world: int | None = None,
                 f"elastic schedule seed={seed}: spare thread hung after "
                 f"tracker stop")
 
+    # HA runs: the job's timeline spans BOTH trackers — the primary's
+    # events up to its death, the promoted standby's from takeover (the
+    # standby seeds its own sync/failover events into the tracker it
+    # promotes).  Every assert below reads the merged line.
+    promoted_tracker = (standby.tracker
+                        if standby is not None and standby.promoted.is_set()
+                        else None)
+    all_events = list(tracker.events)
+    if promoted_tracker is not None:
+        all_events += list(promoted_tracker.events)
+    elif standby is not None:
+        all_events += list(standby.events)
+    active_tracker = (promoted_tracker if promoted_tracker is not None
+                      else tracker)
     completed = [r for r in results.values() if r.completed]
     died = [r for r in results.values() if r.died]
     # -- convergence: every never-killed primary completes with the exact
@@ -889,13 +978,13 @@ def run_elastic_schedule(seed: int, world: int | None = None,
                 f"seed={seed}: task {res.task_id} state diverges bitwise "
                 f"from task {completed[0].task_id}")
     # -- value correctness against the closed form.
-    qm = [e for e in tracker.events if e["kind"] == "quorum_met"]
+    qm = [e for e in all_events if e["kind"] == "quorum_met"]
     folded = {(e["src_version"], e["rank"])
-              for e in tracker.events if e["kind"] == "correction_folded"}
+              for e in all_events if e["kind"] == "correction_folded"}
     missing = {(e["version"], r, e["world"])
                for e in qm for r in e["excluded"]}
     missing = {(sv, r, w) for (sv, r, w) in missing if (sv, r) not in folded}
-    n_epochs = len(tracker.elastic.history)
+    n_epochs = len(active_tracker.elastic.history)
     if ref is not None:
         if not quorum:
             if not np.array_equal(ref, expected):
@@ -941,7 +1030,7 @@ def run_elastic_schedule(seed: int, world: int | None = None,
                     f"seed={seed}: state {ref!r} outside "
                     f"[{floor!r}, {expected!r}]")
     # -- membership sanity on the tracker's committed timeline.
-    waves = [e for e in tracker.events if e["kind"] == "wave"]
+    waves = [e for e in all_events if e["kind"] == "wave"]
     epochs = [e["epoch"] for e in waves]
     if epochs != sorted(set(epochs)):
         raise AssertionError(f"seed={seed}: epochs not strictly "
@@ -958,13 +1047,14 @@ def run_elastic_schedule(seed: int, world: int | None = None,
     # lease expired (the padded upstream lease must cover the gap).
     died_tasks = {tid for tid, r in results.items()
                   if getattr(r, "died", False)}
-    expired_tasks = {e.get("task_id") for e in tracker.events
+    expired_tasks = {e.get("task_id") for e in all_events
                      if e["kind"] == "lease_expired"}
     spurious = expired_tasks - died_tasks - set(kill_at)
-    if relays and spurious:
+    if (relays or failover is not None) and spurious:
         raise AssertionError(
             f"seed={seed}: spurious lease_expired for live tasks "
-            f"{sorted(spurious)} (relay bounce must not kill children)")
+            f"{sorted(spurious)} (a relay bounce or tracker failover "
+            f"must not kill children)")
     dst_res = results.get(str(slow_link[1])) if slow_link is not None else None
     cadence = 0.0
     ct = getattr(results.get("0"), "commit_times", None) or {}
@@ -975,11 +1065,11 @@ def run_elastic_schedule(seed: int, world: int | None = None,
         n_completed=len(completed), n_died=len(died),
         worlds_seen=worlds_seen,
         epochs=[{"epoch": we.epoch, "world": we.world_size}
-                for we in tracker.elastic.history],
+                for we in active_tracker.elastic.history],
         elapsed=time.monotonic() - t0,
         outcome="completed",
         schedule=schedule,
-        n_repaired=sum(1 for e in tracker.events
+        n_repaired=sum(1 for e in all_events
                        if e["kind"] == "schedule_repaired"),
         dst_wait_s=getattr(dst_res, "wait_prev_s", 0.0),
         dst_slow_reports=getattr(dst_res, "slow_reports", 0),
@@ -987,15 +1077,21 @@ def run_elastic_schedule(seed: int, world: int | None = None,
         straggler=(s_rank, s_delay, s_heal) if straggler is not None
         else None,
         n_quorum_met=len(qm),
-        n_corrections_folded=sum(1 for e in tracker.events
+        n_corrections_folded=sum(1 for e in all_events
                                  if e["kind"] == "correction_folded"),
-        n_corrections_dropped=sum(1 for e in tracker.events
+        n_corrections_dropped=sum(1 for e in all_events
                                   if e["kind"] == "correction_dropped"),
         cadence_s=round(cadence, 6),
         relays=int(relays),
-        n_relay_lost=sum(1 for e in tracker.events
+        n_relay_lost=sum(1 for e in all_events
                          if e["kind"] == "relay_lost"),
-        n_batches_folded=sum(1 for e in tracker.events
+        n_batches_folded=sum(1 for e in all_events
                              if e["kind"] == "batch_folded"),
         n_spurious_expired=len(spurious),
+        standby=standby is not None,
+        n_failover=sum(1 for e in all_events
+                       if e["kind"] == "tracker_failover"),
+        n_journal_gap=sum(1 for e in all_events
+                          if e["kind"] == "journal_gap"),
+        primary_killed=bool(getattr(tracker, "_killed", False)),
     )

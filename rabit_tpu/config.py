@@ -169,6 +169,22 @@ DEFAULTS: dict[str, str] = {
     # O(10^3)+ direct worlds (relayed deployments keep the root's accept
     # count at O(relays) instead).
     "rabit_tracker_backlog": "1024",
+    # HA control plane (rabit_tpu/ha, doc/ha.md).  rabit_tracker_addrs:
+    # comma-separated "host:port" tracker addresses (the primary first,
+    # then its warm standby) — every tracker_rpc rotates through them on
+    # failure, so a primary tracker death fails over client-side.
+    # rabit_ha_journal: path of the durable control-plane journal the
+    # tracker appends every mutation to (empty = journaling off);
+    # rabit_ha_snapshot_every: records between compacted snapshots (the
+    # replay-cost bound); rabit_ha_takeover_sec: the standby's takeover
+    # lease — how long the primary may be unreachable/silent before the
+    # standby promotes itself; rabit_ha_tick_sec: the primary's journal
+    # keepalive cadence (the liveness signal that lease watches).
+    "rabit_tracker_addrs": "",
+    "rabit_ha_journal": "",
+    "rabit_ha_snapshot_every": "256",
+    "rabit_ha_takeover_sec": "1.0",
+    "rabit_ha_tick_sec": "0.25",
     # Default ON, matching the native engine (see comm.cc Configure): with
     # Nagle on, every cold-direction header write stalls ~40ms behind the
     # peer's delayed ACK — measured 44ms/op on loopback object broadcasts.

@@ -128,7 +128,7 @@ class ElasticWorker:
 
     def __init__(
         self,
-        tracker: tuple[str, int],
+        tracker,
         task_id: str,
         contribution: Callable[[int, int, int], np.ndarray],
         niter: int,
@@ -146,7 +146,17 @@ class ElasticWorker:
         quorum_wait: float = 0.35,
         codec: str = "",
     ):
-        self.tracker = (tracker[0], int(tracker[1]))
+        # ``tracker`` is one (host, port) or a failover LIST of them
+        # (rabit_tracker_addrs, doc/ha.md: the primary first, then its
+        # warm standby); every tracker RPC and raw check-in connection
+        # rotates through the list, so a primary tracker death is a
+        # retry, not a job loss.
+        if tracker and isinstance(tracker[0], (tuple, list)):
+            self.addrs = [(t[0], int(t[1])) for t in tracker]
+        else:
+            self.addrs = [(tracker[0], int(tracker[1]))]
+        self.tracker = self.addrs[0]
+        self._active = 0  # index of the address that last answered
         self.task_id = task_id
         self.contribution = contribution
         self.niter = int(niter)
@@ -232,6 +242,23 @@ class ElasticWorker:
 
     # -- tracker RPCs --------------------------------------------------------
 
+    def _connect(self, timeout: float) -> socket.socket:
+        """Dial the tracker, rotating through the failover address list
+        starting from the last one that answered (doc/ha.md).  Raises
+        the last OSError when no address answers."""
+        last: Exception | None = None
+        for i in range(len(self.addrs)):
+            idx = (self._active + i) % len(self.addrs)
+            try:
+                sock = socket.create_connection(self.addrs[idx],
+                                                timeout=timeout)
+            except OSError as exc:
+                last = exc
+                continue
+            self._active = idx
+            return sock
+        raise last if last is not None else OSError("no tracker address")
+
     def _checkin(self, cmd: int, prev_rank: int) -> P.Assignment:
         """START/RECOVER check-in on a raw socket: the reply is either an
         Assignment (the wave closed with us in it) or a park frame (the
@@ -244,8 +271,7 @@ class ElasticWorker:
             self._check_deadline()
             sock = None
             try:
-                sock = socket.create_connection(self.tracker,
-                                                timeout=self.rpc_timeout)
+                sock = self._connect(self.rpc_timeout)
                 P.send_hello(sock, cmd, self.task_id, prev_rank=prev_rank,
                              listen_port=self.advertise_port
                              or self.listen_port)
@@ -301,8 +327,7 @@ class ElasticWorker:
         """CMD_SPARE park: receive the cached bootstrap blob, then hold
         the warm socket until promoted (Assignment), released (EOF at
         job end), or told to die by the fail schedule."""
-        sock = socket.create_connection(self.tracker,
-                                        timeout=self.rpc_timeout)
+        sock = self._connect(self.rpc_timeout)
         try:
             P.send_hello(sock, P.CMD_SPARE, self.task_id,
                          listen_port=self.advertise_port
@@ -357,7 +382,8 @@ class ElasticWorker:
         try:
             P.tracker_rpc(self.tracker[0], self.tracker[1], P.CMD_PRINT,
                           self.task_id, prev_rank=asg.rank, message=line,
-                          timeout=self.rpc_timeout, retries=1)
+                          timeout=self.rpc_timeout, retries=1,
+                          addrs=self.addrs)
         except (P.TrackerUnreachable, ValueError):
             pass  # reporting must never fail the job
 
@@ -366,7 +392,7 @@ class ElasticWorker:
             info = P.tracker_rpc(
                 self.tracker[0], self.tracker[1], P.CMD_EPOCH, self.task_id,
                 prev_rank=self._rank, message=str(self._version),
-                timeout=self.rpc_timeout, retries=1)
+                timeout=self.rpc_timeout, retries=1, addrs=self.addrs)
             return info if isinstance(info, dict) else None
         except (P.TrackerUnreachable, ValueError):
             return None
@@ -381,8 +407,7 @@ class ElasticWorker:
             pickle.dumps((self._version, self._state),
                          protocol=pickle.HIGHEST_PROTOCOL))
         try:
-            with socket.create_connection(self.tracker,
-                                          timeout=self.rpc_timeout) as sock:
+            with self._connect(self.rpc_timeout) as sock:
                 P.send_hello(sock, P.CMD_BLOB, self.task_id,
                              blob=blob, blob_version=self._version)
                 P.get_u32(sock)  # ACK — best-effort, errors tolerated
@@ -753,7 +778,8 @@ class ElasticWorker:
             reply = P.tracker_rpc(self.tracker[0], self.tracker[1],
                                   P.CMD_QUORUM, self.task_id,
                                   prev_rank=asg.rank, message=msg,
-                                  timeout=self.rpc_timeout, retries=1)
+                                  timeout=self.rpc_timeout, retries=1,
+                                  addrs=self.addrs)
             return reply if isinstance(reply, dict) else None
         except (P.TrackerUnreachable, ValueError):
             return None
@@ -907,7 +933,7 @@ class ElasticWorker:
             if self._stop.is_set():
                 return False
             return renew_lease(host, port, self.task_id, self.heartbeat_sec,
-                               rank=self._rank)
+                               rank=self._rank, addrs=self.addrs)
 
         self._hb = Heartbeat(self.heartbeat_sec, tick, immediate=True).start()
 
@@ -1040,9 +1066,15 @@ class ElasticWorker:
         # Clean shutdown handshake (tracker job accounting).
         self._stop_heartbeat()
         try:
+            # With a failover list the budget spans a takeover window:
+            # the rotation needs enough attempts to outlive the
+            # standby's takeover lease, or completion accounting loses
+            # this rank's clean exit (doc/ha.md).
             P.tracker_rpc(self.tracker[0], self.tracker[1], P.CMD_SHUTDOWN,
                           self.task_id, prev_rank=asg.rank,
-                          timeout=self.rpc_timeout, retries=1)
+                          timeout=self.rpc_timeout,
+                          retries=7 if len(self.addrs) > 1 else 1,
+                          backoff_cap=0.5, addrs=self.addrs)
         except (P.TrackerUnreachable, ValueError):
             pass
         res.completed = True

@@ -114,6 +114,26 @@ class MembershipManager:
         so workers re-enter a wave at their next version boundary."""
         return n_spares > 0 and self.world < self.base_world
 
+    def restore(self, epoch: int, world_size: int,
+                rank_map: Mapping[str, int],
+                history: list[tuple[int, int]] | None = None) -> None:
+        """Adopt a replayed membership line (HA failover, doc/ha.md):
+        the promoted tracker must continue the SAME monotonic epoch
+        numbering — a reused epoch would let stale-epoch peer links and
+        quorum records collide with fresh ones.  ``history`` rebuilds
+        the telemetry timeline as ``(epoch, world)`` pairs (rank maps of
+        past epochs are not retained by the journal's compacted
+        state)."""
+        self.current = WorldEpoch(int(epoch), int(world_size),
+                                  dict(rank_map))
+        self.history = [WorldEpoch(int(e), int(w), {})
+                        for e, w in (history or [])]
+        if history and self.history:
+            # the newest history entry is the current epoch: keep its map
+            last = self.history[-1]
+            if last.epoch == self.current.epoch:
+                self.history[-1] = self.current
+
     # -- the wave decision ---------------------------------------------------
 
     def decide(self, n_pending: int, n_spares: int,

@@ -1,0 +1,32 @@
+"""HA control plane — journaled tracker state and warm-standby failover.
+
+rabit's contract is that any *worker* can die and the job keeps going;
+until this package, the job still died with its tracker — the one
+process owning rank assignment, the lease table, membership epochs,
+wave state, the QuorumTable, and the schedule plans (ROADMAP.md's last
+single point of failure; PAPERS.md "Highly Available Data Parallel ML
+training on Mesh Networks" makes the same point for TPU pods: the
+control plane, not the data plane, turns a preemption into a job
+loss).  Three pieces close it (doc/ha.md):
+
+* :class:`~rabit_tpu.ha.state.ControlState` — the control plane as a
+  pure replayable state machine with a CANONICAL byte snapshot;
+* :class:`~rabit_tpu.ha.journal.Journal` — every mutation appended as a
+  framed, crc'd, codec-tagged record (``protocol.put_journal_frame``,
+  the durable store's RTC2 layout), compacted to O(live state), written
+  to ``rabit_ha_journal`` and/or streamed over ``CMD_JOURNAL``;
+* :class:`~rabit_tpu.ha.standby.Standby` — tails the journal, replays
+  it (byte-asserted against the primary's snapshots), and takes over on
+  the primary's takeover lease — workers and relays fail over
+  client-side via ``rabit_tracker_addrs``, the interrupted wave
+  re-forms, and the job's collectives stay bitwise identical.
+
+"Kill the tracker mid-wave" is now just another chaos schedule
+(``rabit_tpu.chaos.run_elastic_schedule(failover=...)``).
+"""
+
+from rabit_tpu.ha.journal import Journal, read_journal, replay
+from rabit_tpu.ha.standby import Standby
+from rabit_tpu.ha.state import ControlState
+
+__all__ = ["ControlState", "Journal", "Standby", "read_journal", "replay"]

@@ -1,0 +1,59 @@
+"""Standalone warm-standby CLI (doc/ha.md).
+
+    python -m rabit_tpu.ha --primary HOST:PORT [--host H] [--port P] \\
+        [--journal PATH] [--takeover-sec S] [--id standby0]
+
+Runs a :class:`~rabit_tpu.ha.standby.Standby` until it is promoted (or
+killed).  Defaults come from the config layer: ``rabit_ha_journal``,
+``rabit_ha_takeover_sec``, ``rabit_ha_tick_sec`` (doc/parameters.md).
+Deployments that launch through ``rabit_tpu.tracker.launcher`` get the
+same thing in-process via ``--standby``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from rabit_tpu.config import Config
+from rabit_tpu.ha.standby import Standby
+
+
+def main(argv: list[str] | None = None) -> int:
+    cfg = Config()
+    ap = argparse.ArgumentParser(prog="rabit_tpu.ha", description=__doc__)
+    ap.add_argument("--primary", required=True, metavar="HOST:PORT",
+                    help="the primary tracker to tail over CMD_JOURNAL")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=0,
+                    help="the standby's advertised port (the second "
+                         "rabit_tracker_addrs entry); 0 picks one")
+    ap.add_argument("--journal",
+                    default=cfg.get("rabit_ha_journal", "") or None,
+                    help="journal file the promoted tracker writes "
+                         "(default: rabit_ha_journal)")
+    ap.add_argument("--takeover-sec", type=float,
+                    default=float(cfg.get("rabit_ha_takeover_sec",
+                                          "1.0") or "1.0"))
+    ap.add_argument("--id", default="standby0")
+    args = ap.parse_args(argv)
+    host, _, port_s = args.primary.rpartition(":")
+    standby = Standby(primary=(host, int(port_s)), host=args.host,
+                      port=args.port, standby_id=args.id,
+                      takeover_sec=args.takeover_sec,
+                      journal=args.journal, quiet=False).start()
+    print(f"[standby {args.id}] advertising {standby.host}:{standby.port} "
+          f"(add it to rabit_tracker_addrs)", flush=True)
+    try:
+        standby.wait_promoted()
+        if standby.tracker is not None:
+            standby.tracker.wait()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        standby.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,252 @@
+"""The durable control-plane journal — append, compact, stream.
+
+One :class:`Journal` owns a single writer thread fed by a queue: every
+tracker mutation point enqueues a ``(kind, fields)`` record
+(non-blocking, safe under the tracker lock), and the writer frames it
+(``protocol.put_journal_frame`` — crc'd, codec-tagged, the durable
+store's RTC2 layout), appends it to the ``rabit_ha_journal`` file (when
+one is configured), folds it into the in-memory
+:class:`~rabit_tpu.ha.state.ControlState` mirror, and fans the frame
+out to every subscriber (the CMD_JOURNAL channels streaming to warm
+standbys).  A single writer means file bytes, mirror state, and every
+subscriber see the records in ONE total order — which is what makes
+"standby replay == primary snapshot" a byte comparison instead of a
+race.
+
+Compaction: after ``snapshot_every`` records the writer rewrites the
+file as ONE ``snapshot`` record (atomic tmp + rename, the store.py
+protocol) and pushes the same snapshot frame to subscribers — replay
+stays O(live state), not O(history), and every streaming standby gets a
+fresh byte-assert point (a divergent standby notes a ``journal_gap``
+and self-heals by adopting the snapshot).
+
+Opening an existing journal replays it first (torn tail records are
+truncated — the crc reads them as absent) and immediately compacts, so
+a tracker promoted over an inherited journal starts from a clean
+snapshot head.  ``path=None`` keeps the journal memory-only: the mirror
+and the subscriber stream still work, which is all a streamed
+(CMD_JOURNAL) standby needs.
+
+Durability scope: writes are flushed per record but NOT fsync'd by
+default (``fsync=True`` opts in) — the journal's first job is failover
+(the standby holds the state in memory), the file is the restart /
+audit trail.  A lost tail record costs one re-formed wave, never a
+wrong bit: workers re-enter recovery waves and every decision is
+re-derived deterministically from the replayed prefix.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+from typing import Callable
+
+from rabit_tpu.ha.state import ControlState
+from rabit_tpu.tracker import protocol as P
+
+
+def read_journal(path: str) -> tuple[list[tuple[str, dict]], bool]:
+    """Read every intact record of a journal file.  Returns
+    ``(records, torn)`` — ``torn`` flags a trailing partial/corrupt
+    frame (truncated by the reader, kept on disk: the next writer
+    compacts over it)."""
+    try:
+        with open(path, "rb") as f:
+            data = f.read()
+    except FileNotFoundError:
+        return [], False
+    records, consumed, err = P.journal_frames_from_buffer(data)
+    return records, (err is not None or consumed < len(data))
+
+
+def replay(records: list[tuple[str, dict]],
+           state: ControlState | None = None) -> ControlState:
+    """Fold records into ``state`` (a fresh one by default)."""
+    state = state if state is not None else ControlState()
+    for kind, fields in records:
+        state.apply(kind, fields)
+    return state
+
+
+class Journal:
+    """One tracker's control-plane journal (module docstring).
+
+    ``state`` seeds the mirror (a promoted tracker passes the state it
+    replayed as a standby); ``on_event`` receives ``{"kind":
+    "journal_snapshot"|"journal_gap", ...}`` dicts from the writer
+    thread (the tracker appends them to its telemetry timeline).
+    """
+
+    def __init__(self, path: str | None = None, codec: str = "zlib",
+                 snapshot_every: int = 256,
+                 state: ControlState | None = None,
+                 on_event: Callable[[dict], None] | None = None,
+                 fsync: bool = False):
+        self.path = path
+        self.codec = codec
+        self.snapshot_every = max(int(snapshot_every), 1)
+        self.fsync = bool(fsync)
+        self.on_event = on_event
+        self._state = state if state is not None else ControlState()
+        self._lock = threading.Lock()  # mirror reads vs writer applies
+        self._subs: list[queue.Queue] = []
+        self._q: queue.Queue = queue.Queue()
+        self._file = None
+        self._since_snapshot = 0
+        self.n_appended = 0
+        self.n_snapshots = 0
+        self._closed = threading.Event()
+        # A caller-supplied state is AUTHORITATIVE (a promoted standby
+        # already replayed this very file / its stream): the existing
+        # file is compacted over, never re-applied — replaying it into
+        # the supplied state would double-count every record.
+        self._seeded = state is not None
+        if path:
+            self._bootstrap_file(path)
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="rabit-ha-journal")
+        self._thread.start()
+
+    # -- public API (any thread; everything enqueues) -----------------------
+
+    def append(self, kind: str, **fields) -> None:
+        """Record one control-plane mutation.  Non-blocking: safe to
+        call under the tracker lock (the frame/write/fan-out happens on
+        the writer thread, in enqueue order)."""
+        if not self._closed.is_set():
+            self._q.put(("rec", kind, fields))
+
+    def subscribe(self) -> queue.Queue:
+        """A live frame stream seeded with a snapshot of the current
+        mirror: the writer enqueues the snapshot frame and then every
+        later record, so a subscriber replays to exactly the primary's
+        state with no gap and no duplicate."""
+        sub: queue.Queue = queue.Queue()
+        self._q.put(("sub", sub))
+        return sub
+
+    def unsubscribe(self, sub: queue.Queue) -> None:
+        self._q.put(("unsub", sub))
+
+    def flush(self, timeout: float = 5.0) -> bool:
+        """Block until every record enqueued so far is written and
+        fanned out (tests; pre-handoff barriers)."""
+        done = threading.Event()
+        self._q.put(("flush", done))
+        return done.wait(timeout)
+
+    def close(self) -> None:
+        self._closed.set()
+        self._q.put(None)
+        self._thread.join(timeout=5.0)
+
+    def state_bytes(self) -> bytes:
+        """The mirror's canonical snapshot bytes (the primary side of
+        the replay-determinism byte assert)."""
+        with self._lock:
+            return self._state.snapshot_bytes()
+
+    def state_snapshot(self) -> dict:
+        with self._lock:
+            return self._state.snapshot()
+
+    # -- writer thread ------------------------------------------------------
+
+    def _bootstrap_file(self, path: str) -> None:
+        """Open (and, when it already exists, replay + compact) the
+        journal file.  Runs on the constructing thread so the mirror is
+        ready before the tracker starts mutating.  With a seeded state
+        the file is NOT re-applied (the seed already is its replay) —
+        it is simply compacted under a snapshot of the seed."""
+        records, torn = read_journal(path)
+        if records and not self._seeded:
+            with self._lock:
+                replay(records, self._state)
+        if torn:
+            self._emit({"kind": "journal_gap", "path": path,
+                        "records": len(records)})
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        if records or torn:
+            self._compact()  # a clean snapshot head over the old history
+        else:
+            self._file = open(path, "ab")
+
+    def _emit(self, event: dict) -> None:
+        if self.on_event is not None:
+            try:
+                self.on_event(event)
+            except Exception:  # noqa: BLE001 — telemetry must not kill IO
+                pass
+
+    def _snapshot_frame(self) -> bytes:
+        with self._lock:
+            snap = self._state.snapshot()
+        return P.put_journal_frame("snapshot", {"state": snap}, self.codec)
+
+    def _compact(self) -> None:
+        """Rewrite the file as one snapshot record (atomic replace, the
+        store.py tmp+rename protocol) and push the same snapshot frame
+        to subscribers as their byte-assert point."""
+        frame = self._snapshot_frame()
+        if self.path:
+            if self._file is not None:
+                try:
+                    self._file.close()
+                except OSError:
+                    pass
+            tmp = f"{self.path}.tmp.{os.getpid()}"
+            with open(tmp, "wb") as f:
+                f.write(frame)
+                f.flush()
+                if self.fsync:
+                    os.fsync(f.fileno())
+            os.replace(tmp, self.path)
+            self._file = open(self.path, "ab")
+        for sub in self._subs:
+            sub.put(frame)
+        self._since_snapshot = 0
+        self.n_snapshots += 1
+        self._emit({"kind": "journal_snapshot", "n": self.n_snapshots,
+                    "nbytes": len(frame)})
+
+    def _run(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is None:
+                break
+            op = item[0]
+            if op == "rec":
+                _, kind, fields = item
+                frame = P.put_journal_frame(kind, fields, self.codec)
+                with self._lock:
+                    self._state.apply(kind, fields)
+                if self._file is not None:
+                    try:
+                        self._file.write(frame)
+                        self._file.flush()
+                        if self.fsync:
+                            os.fsync(self._file.fileno())
+                    except OSError:
+                        pass  # a full disk must not take the tracker down
+                for sub in self._subs:
+                    sub.put(frame)
+                self.n_appended += 1
+                self._since_snapshot += 1
+                if self._since_snapshot >= self.snapshot_every:
+                    self._compact()
+            elif op == "sub":
+                sub = item[1]
+                sub.put(self._snapshot_frame())
+                self._subs.append(sub)
+            elif op == "unsub":
+                sub = item[1]
+                if sub in self._subs:
+                    self._subs.remove(sub)
+            elif op == "flush":
+                item[1].set()
+        if self._file is not None:
+            try:
+                self._file.close()
+            except OSError:
+                pass

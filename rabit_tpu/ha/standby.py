@@ -1,0 +1,320 @@
+"""The warm-standby tracker — tail, replay, take over.
+
+A :class:`Standby` is the second half of the HA control plane
+(doc/ha.md): it binds its advertised address IMMEDIATELY (bound but not
+listening, so clients probing it pre-takeover get connection-refused
+and rotate back to the primary — ``tracker_rpc``'s address-list
+failover), tails the primary's journal, and replays every record into
+an identical :class:`~rabit_tpu.ha.state.ControlState`.  Two sync
+transports, same frames:
+
+* **streamed** — one persistent ``CMD_JOURNAL`` channel to the primary:
+  a snapshot record first, then every mutation as it commits, plus
+  ``tick`` keepalives.  Every snapshot frame after the first is a
+  byte-assert point: the standby compares its replayed state against
+  the primary's snapshot and notes a ``journal_gap`` (then self-heals
+  by adopting the snapshot) on divergence — the replay-determinism gate
+  running live.
+* **file** — tail a shared ``rabit_ha_journal`` file (compactions
+  replace the inode; the tailer detects the swap and re-reads).
+
+Takeover is lease-shaped (``rabit_ha_takeover_sec``): the primary is
+suspected when the channel stays down — or silent past the tick
+cadence — for a full takeover lease.  The standby then listens on its
+pre-bound socket and constructs a real
+:class:`~rabit_tpu.tracker.tracker.Tracker` seeded with the replayed
+state (``resume_from=``): ranks, epochs, quorum records, link flags and
+the spare pool survive; journaled leases are re-armed with fresh
+deadlines so a worker that died during the cut is still suspected.
+Workers and relays fail over client-side (``rabit_tracker_addrs``) and
+the interrupted wave re-forms on the standby — deterministically, so
+the re-completed collectives are bitwise identical to an undisturbed
+run (asserted by the chaos failover campaigns).
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import threading
+import time
+
+from rabit_tpu.ha.journal import Journal
+from rabit_tpu.ha.state import ControlState
+from rabit_tpu.tracker import protocol as P
+
+
+class Standby:
+    """One warm-standby tracker (module docstring).
+
+    ``primary=(host, port)`` selects the streamed CMD_JOURNAL transport;
+    ``journal_path=`` the file-tail transport (give both: the stream
+    syncs, the file is the liveness fallback — but one is enough).
+    ``tracker_kwargs`` are passed through to the promoted
+    :class:`Tracker` (schedule, quorum, on_suspect, ...).
+    """
+
+    def __init__(self, primary: tuple[str, int] | None = None,
+                 journal_path: str | None = None,
+                 host: str = "127.0.0.1", port: int = 0,
+                 standby_id: str = "standby0",
+                 takeover_sec: float = 1.0,
+                 poll_sec: float = 0.1,
+                 journal: str | None = None,
+                 tracker_kwargs: dict | None = None,
+                 quiet: bool = True):
+        if primary is None and journal_path is None:
+            raise ValueError("standby needs a primary address and/or a "
+                             "journal path to tail")
+        self.primary = ((primary[0], int(primary[1]))
+                        if primary is not None else None)
+        self.journal_path = journal_path
+        self.standby_id = standby_id
+        self.takeover_sec = float(takeover_sec)
+        self.poll_sec = float(poll_sec)
+        #: journal path the PROMOTED tracker writes (defaults to the
+        #: tailed file, so the journal line continues across a failover)
+        self.promoted_journal = journal if journal is not None \
+            else journal_path
+        self.tracker_kwargs = dict(tracker_kwargs or {})
+        self.quiet = quiet
+        self.state = ControlState()
+        self.events: list[dict] = []  # seeded into the promoted tracker
+        self.synced = threading.Event()     # first snapshot applied
+        self.promoted = threading.Event()
+        self.tracker = None  # the promoted Tracker, once promoted
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        # Bind the advertised address NOW, listen only at takeover: a
+        # bound-unlistening socket refuses connections, which is exactly
+        # the "not serving yet" signal the client-side rotation expects.
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self.host, self.port = self._sock.getsockname()
+        self._thread: threading.Thread | None = None
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> "Standby":
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name=f"rabit-ha-{self.standby_id}")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        """Clean teardown: stops the sync loop and the promoted tracker
+        (when one exists)."""
+        self._stop.set()
+        tracker = self.tracker
+        if tracker is not None:
+            tracker.stop()
+        else:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+
+    def kill(self) -> None:
+        """Abrupt death (chaos ``standby_death``): the standby — or the
+        tracker it promoted to — disappears without cleanup."""
+        self._stop.set()
+        tracker = self.tracker
+        if tracker is not None:
+            tracker.kill()
+        else:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+
+    def wait_synced(self, timeout: float | None = None) -> bool:
+        return self.synced.wait(timeout)
+
+    def wait_promoted(self, timeout: float | None = None) -> bool:
+        return self.promoted.wait(timeout)
+
+    # -- sync loop ----------------------------------------------------------
+
+    def _note(self, ev: dict) -> None:
+        """Record one standby event (the dict carries a literal "kind"
+        so the event-kind registry check sees the emission)."""
+        ev = {"ts": round(time.time(), 6), **ev}
+        with self._lock:
+            self.events.append(ev)
+        if not self.quiet:
+            print(f"[standby {self.standby_id}] {ev}", flush=True)
+
+    def _apply_records(self, records: list[tuple[str, dict]]) -> None:
+        """Fold tailed records in; snapshot records after the first sync
+        byte-assert the replay against the primary's state."""
+        for kind, fields in records:
+            if kind == "snapshot" and self.synced.is_set():
+                mine = self.state.snapshot_bytes()
+                theirs = ControlState.from_snapshot(
+                    fields["state"]).snapshot_bytes()
+                if mine != theirs:
+                    # Divergence means records were lost or applied
+                    # differently: evidence first, then self-heal by
+                    # adopting the primary's snapshot.
+                    self._note({"kind": "journal_gap",
+                                "applied": self.state.applied,
+                                "mine": len(mine), "theirs": len(theirs)})
+                    self.state.apply(kind, fields)
+                continue
+            self.state.apply(kind, fields)
+            if kind == "snapshot" and not self.synced.is_set():
+                self._note({"kind": "standby_synced",
+                            "epoch": self.state.epoch,
+                            "world": self.state.world})
+                self.synced.set()
+
+    def _run(self) -> None:
+        """Tail until the primary's takeover lease lapses, then promote.
+        ``alive_at`` is refreshed by every byte that arrives (stream) or
+        every successful read/probe (file)."""
+        alive_at = time.monotonic()
+        chan: socket.socket | None = None
+        buf = bytearray()
+        file_pos = 0
+        file_id: tuple[int, int] | None = None  # (st_ino, st_size basis)
+        while not self._stop.is_set():
+            now = time.monotonic()
+            if now - alive_at > self.takeover_sec:
+                if chan is not None:
+                    try:
+                        chan.close()
+                    except OSError:
+                        pass
+                self._take_over()
+                return
+            if self.primary is not None:
+                if chan is None:
+                    chan = self._dial_primary()
+                    if chan is not None:
+                        buf = bytearray()
+                if chan is not None:
+                    got = self._pump_channel(chan, buf)
+                    if got is None:  # channel died
+                        try:
+                            chan.close()
+                        except OSError:
+                            pass
+                        chan = None
+                    elif got:
+                        alive_at = time.monotonic()
+                    continue  # the pump's recv timeout already paced us
+            if self.journal_path is not None:
+                file_pos, file_id, fresh = self._tail_file(file_pos, file_id)
+                if fresh:
+                    alive_at = time.monotonic()
+            self._stop.wait(self.poll_sec)
+
+    def _dial_primary(self) -> socket.socket | None:
+        try:
+            chan = socket.create_connection(self.primary, timeout=1.0)
+            chan.settimeout(1.0)
+            P.send_hello(chan, P.CMD_JOURNAL, self.standby_id)
+            if P.get_u32(chan) != P.ACK:
+                chan.close()
+                return None
+            chan.settimeout(self.poll_sec)
+            return chan
+        except (ConnectionError, OSError, ValueError):
+            return None
+
+    def _pump_channel(self, chan: socket.socket,
+                      buf: bytearray) -> bool | None:
+        """One bounded read + frame parse.  Returns True when bytes
+        arrived, False on a quiet tick, None when the channel died."""
+        try:
+            data = chan.recv(65536)
+        except socket.timeout:
+            return False
+        except OSError:
+            return None
+        if not data:
+            return None
+        buf += data
+        records, consumed, err = P.journal_frames_from_buffer(bytes(buf))
+        del buf[:consumed]
+        self._apply_records(records)
+        if err is not None:
+            self._note({"kind": "journal_gap", "transport": "stream",
+                        "error": err})
+            return None  # resync from a fresh snapshot on reconnect
+        return True
+
+    def _tail_file(self, pos: int, fid: tuple[int, int] | None
+                   ) -> tuple[int, tuple[int, int] | None, bool]:
+        """Read any new complete frames past ``pos``; a compaction
+        (inode swap / shrink) restarts the replay from the new snapshot
+        head."""
+        path = self.journal_path
+        try:
+            st = os.stat(path)
+        except OSError:
+            return pos, fid, False
+        if fid is not None and (st.st_ino != fid[0] or st.st_size < pos):
+            pos = 0  # compacted: the file now starts with a snapshot
+        fid = (st.st_ino, st.st_size)
+        if st.st_size <= pos:
+            return pos, fid, False
+        try:
+            with open(path, "rb") as f:
+                f.seek(pos)
+                data = f.read()
+        except OSError:
+            return pos, fid, False
+        records, consumed, err = P.journal_frames_from_buffer(data)
+        self._apply_records(records)
+        if records and not self.synced.is_set():
+            # a file tailed from byte 0 is consistent from the first
+            # record (the stream transport waits for its snapshot head)
+            self._note({"kind": "standby_synced",
+                        "epoch": self.state.epoch,
+                        "world": self.state.world})
+            self.synced.set()
+        if err is not None:
+            # mid-file corruption: stop before it; the primary's next
+            # compaction rewrites the file and the tailer resyncs
+            self._note({"kind": "journal_gap", "transport": "file",
+                        "error": err})
+        return pos + consumed, fid, bool(records)
+
+    # -- takeover -----------------------------------------------------------
+
+    def _take_over(self) -> None:
+        from rabit_tpu.tracker.tracker import Tracker
+
+        if self._stop.is_set():
+            return
+        self._note({"kind": "tracker_failover",
+                    "standby": self.standby_id,
+                    "epoch": self.state.epoch, "world": self.state.world,
+                    "synced": self.synced.is_set()})
+        kwargs = dict(self.tracker_kwargs)
+        kwargs.setdefault("quiet", self.quiet)
+        journal = None
+        if self.promoted_journal:
+            journal = Journal(self.promoted_journal, state=self.state)
+        # listen() happens inside Tracker (listen_sock=): the pre-bound
+        # socket starts refusing dials only now, which is exactly when
+        # the client-side rotation should start landing here.
+        tracker = Tracker(
+            self.state.base_world or self.state.world or 1,
+            listen_sock=self._sock,
+            resume_from=self.state,
+            journal=journal,
+            **kwargs)
+        with self._lock:
+            tracker.events[:0] = self.events
+        self.tracker = tracker
+        tracker.start()
+        self.promoted.set()
+        if not self.quiet:
+            print(f"[standby {self.standby_id}] promoted to primary at "
+                  f"{self.host}:{self.port} (epoch {self.state.epoch}, "
+                  f"world {self.state.world})", flush=True)

@@ -115,6 +115,15 @@ KINDS: dict[str, str] = {
                     "sub-messages",
     "messages_dropped": "the bounded worker-print log overflowed: cap "
                         "(total drops in telemetry.json)",
+    # HA control plane (rabit_tpu/ha, doc/ha.md)
+    "journal_snapshot": "journal compacted to one snapshot record: n, "
+                        "nbytes",
+    "journal_gap": "journal replay hit a torn/divergent stretch "
+                   "(truncated or healed from a snapshot): error",
+    "standby_synced": "standby replayed to a consistent state: epoch, "
+                      "world",
+    "tracker_failover": "standby promoted itself over the dead primary: "
+                        "standby, epoch, world, synced",
     # collective schedules (rabit_tpu/sched, doc/scheduling.md)
     "schedule_planned": "tracker planned a wave's schedule: epoch, algo, "
                         "ring_order, n_avoided",

@@ -319,6 +319,8 @@ _TRACKER_INSTANTS = {
     "quorum_met", "contribution_late", "correction_folded",
     "correction_dropped",
     "relay_up", "relay_lost", "batch_folded", "messages_dropped",
+    "journal_snapshot", "journal_gap", "standby_synced",
+    "tracker_failover",
 }
 
 

@@ -106,6 +106,25 @@ class QuorumTable:
                     self._streak[r] = 0
         return rec, events, flags
 
+    def has_record(self, epoch: int, version: int) -> bool:
+        """True when the round's exclusion record is already frozen —
+        the tracker journals a freeze exactly once (doc/ha.md)."""
+        return (int(epoch), int(version)) in self._records
+
+    def seed(self, seed: dict) -> None:
+        """Restore the ledgers from a replayed control-plane state
+        (``rabit_tpu.ha.ControlState.quorum_seed``): a promoted tracker
+        must answer every already-decided round with the SAME frozen
+        record, or folds diverge bitwise across the failover."""
+        self._records = {(int(e), int(v)): dict(r)
+                         for (e, v), r in seed.get("records", {}).items()}
+        self._outstanding = {(int(sv), int(r)): int(w) for (sv, r), w in
+                             seed.get("outstanding", {}).items()}
+        self._late_seen = {(int(sv), int(r))
+                           for sv, r in seed.get("late_seen", ())}
+        self._streak = {int(r): int(n)
+                        for r, n in seed.get("streak", {}).items()}
+
     # -- membership boundaries ---------------------------------------------
 
     def epoch_changed(self, epoch: int) -> list[tuple[int, int, int]]:

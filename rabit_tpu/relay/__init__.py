@@ -25,9 +25,15 @@ root tracker:
   the tracker's reply (Assignment, park frame) is routed back by task
   id over the channel — the root accepts O(relays) connections per wave
   instead of O(world);
-* **proxied** — CMD_QUORUM (decide-once reply) and CMD_BLOB (rank-0
-  blob upload) pass straight through on their own short-lived upstream
-  connections;
+* **batched agreement** — CMD_QUORUM reports park the child connection
+  (like a check-in) and ride the next immediate batch; the tracker
+  folds the report and routes the frozen exclusion record back under
+  the child's ``q#``-prefixed key — a quorum-heavy world costs the
+  root one envelope per flush instead of one connection per rank per
+  round, and re-delivery after a channel cut is safe because the
+  tracker's QuorumTable decides each round exactly once;
+* **proxied** — CMD_BLOB (rank-0 blob upload: large and rare) passes
+  straight through on its own short-lived upstream connection;
 * **clock-projected** — the relay brackets every batch round-trip and
   keeps an NTP-style offset estimate against the tracker clock; child
   heartbeat/metrics ACKs carry the PROJECTED tracker time, so PR 3
@@ -40,6 +46,17 @@ reconnects, and the tracker's purge/reap paths treat a dead channel's
 virtual connections as hung up.  Child leases survive a relay bounce
 because the upstream lease interval is padded
 (:data:`RELAY_LEASE_PAD` x the flush cadence).
+
+The ROOT dying is also just a reconnect (doc/ha.md): construct the
+relay with a list of tracker addresses (``rabit_tracker_addrs`` — the
+primary and its warm standby) and the channel rotates to the next
+address when a dial fails.  On every reconnect the relay replays its
+un-ACKed batch envelope (minus the heartbeats/metrics that re-coalesce
+anyway), so no check-in, shutdown, print, or quorum report is lost
+across the failover cut — the new primary dedupes by task id and
+decide-once records, so the replay is idempotent.  Children behind a
+relay therefore never re-dial at all when the root fails over: the
+relay tier IS their stable coordination address.
 """
 
 from __future__ import annotations
@@ -101,11 +118,18 @@ class Relay:
     blocks unboundedly.
     """
 
-    def __init__(self, tracker: tuple[str, int], relay_id: str = "r0",
+    def __init__(self, tracker, relay_id: str = "r0",
                  host: str = "127.0.0.1", port: int = 0,
                  flush_sec: float = 0.25, backlog: int = 1024,
                  rpc_timeout: float = 5.0, quiet: bool = True):
-        self.tracker = (tracker[0], int(tracker[1]))
+        # ``tracker`` is one (host, port) or a failover LIST of them
+        # (primary first — rabit_tracker_addrs, doc/ha.md); the channel
+        # rotates through the list when a dial fails.
+        if tracker and isinstance(tracker[0], (tuple, list)):
+            self.trackers = [(t[0], int(t[1])) for t in tracker]
+        else:
+            self.trackers = [(tracker[0], int(tracker[1]))]
+        self._tr = 0  # index of the address currently believed primary
         self.relay_id = relay_id
         self.flush_sec = float(flush_sec)
         self.rpc_timeout = float(rpc_timeout)
@@ -139,9 +163,24 @@ class Relay:
         self.clock_offset = 0.0   # tracker_ts - relay_ts
         self.clock_err = float("inf")
         self._epoch_cache = {"epoch": 0, "world": 0, "rewave": False}
+        # The last batch's replayable sub-messages, held until its ACK
+        # lands: a channel cut between send and ACK (a root failover)
+        # replays them on the next connect so no check-in, shutdown,
+        # print, or quorum report is lost across the cut (doc/ha.md).
+        # Heartbeats/metrics are excluded — they re-coalesce every
+        # flush anyway.
+        self._unacked: list[P.BatchMsg] = []
+        self._replay = False
         # evidence counters
         self.stats = {"children": 0, "rpcs_terminated": 0, "batches": 0,
-                      "batch_msgs": 0, "routed": 0, "reconnects": 0}
+                      "batch_msgs": 0, "routed": 0, "reconnects": 0,
+                      "failovers": 0, "replayed_msgs": 0}
+
+    @property
+    def tracker(self) -> tuple[str, int]:
+        """The root address currently believed primary (rotated by the
+        channel's reconnect loop on dial failure)."""
+        return self.trackers[self._tr]
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -331,9 +370,32 @@ class Relay:
                     self._defer_close.add(old)
             self._flush_now.set()
             return
-        if h.cmd in (P.CMD_QUORUM, P.CMD_BLOB):
-            # Proxy straight through: the reply must be synchronous and
-            # decided by the root (quorum decide-once; blob versioning).
+        if h.cmd == P.CMD_QUORUM:
+            # Batched agreement (doc/scaling.md, doc/ha.md): park the
+            # child like a check-in and fold the report into the next
+            # immediate envelope; the tracker routes the frozen record
+            # back under the q#-prefixed key and ROUTE_CLOSE delivers
+            # ACK + record JSON to this very socket.  One envelope per
+            # flush replaces one root connection per rank per round.
+            ch.held = True
+            ch.deadline = 0.0
+            key = "q#" + h.task_id
+            ch.task_id = key
+            msg = P.BatchMsg(key, P.CMD_QUORUM, h.prev_rank, ch.addr[0],
+                             0, h.message.encode(), time.time())
+            with self._lock:
+                old = self._held.pop(key, None)
+                self._held[key] = ch.sock
+                self._held_msg[key] = msg
+                self._held_sent.discard(key)
+            if old is not None and old is not ch.sock:
+                with self._lock:
+                    self._defer_close.add(old)
+            self._flush_now.set()
+            return
+        if h.cmd == P.CMD_BLOB:
+            # Proxy straight through: rank-0 blob uploads are large and
+            # rare — the synchronous path keeps them off the envelope.
             self._child_detach(sel, children, ch)
             threading.Thread(target=self._proxy_rpc, args=(ch.sock, h),
                              daemon=True,
@@ -472,14 +534,24 @@ class Relay:
                 return None
             chan.settimeout(None)
         except (ConnectionError, OSError, ValueError):
+            # Root failover rotation (doc/ha.md): the next connect
+            # attempt tries the next configured tracker address — the
+            # standby's pre-bound socket refuses until it takes over,
+            # so the rotation settles on whichever address serves.
+            if len(self.trackers) > 1:
+                self._tr = (self._tr + 1) % len(self.trackers)
+                self.stats["failovers"] += 1
             return None
         with self._chan_lock:
             self._chan = chan
         with self._lock:
             # Parked check-ins must be re-announced on a fresh channel:
             # the tracker replaces a task id's stale pending entry, so a
-            # duplicate hello is safe and a lost one is not.
+            # duplicate hello is safe and a lost one is not.  The last
+            # un-ACKed envelope replays for the same reason (shutdowns,
+            # prints, quorum reports — all idempotent at the tracker).
             self._held_sent.clear()
+            self._replay = bool(self._unacked)
         self.stats["reconnects"] += 1
         threading.Thread(target=self._channel_reader, args=(chan,),
                          daemon=True,
@@ -546,6 +618,8 @@ class Relay:
             if err <= self.clock_err * 2.0 or err < 0.05:
                 self.clock_offset = float(server_ts) - (t_send + t_recv) / 2
                 self.clock_err = err
+        with self._lock:
+            self._unacked = []  # the envelope landed; nothing to replay
         self._ack.set()
 
     def _build_batch(self) -> list[P.BatchMsg]:
@@ -593,16 +667,34 @@ class Relay:
             # refreshes the epoch cache (rewave reaches idle children)
             # and the clock-offset estimate.
             msgs = self._build_batch()
+            with self._lock:
+                if self._replay and self._unacked:
+                    # Fresh channel, un-ACKed envelope outstanding:
+                    # replay it ahead of the new batch — the old root
+                    # may have died between our send and its ACK, and
+                    # the new one dedupes (doc/ha.md).
+                    msgs = self._unacked + msgs
+                    self.stats["replayed_msgs"] += len(self._unacked)
+                self._replay = False
             self._ack.clear()
             self._last_batch_send = time.time()
             try:
                 chan.sendall(P.put_batch_frame(msgs))
             except OSError:
-                # Channel died mid-flush: requeue nothing (heartbeats and
-                # metrics re-coalesce next interval; held hellos re-send
-                # on reconnect via _held_sent), drop, retry.
+                # Channel died mid-flush: requeue nothing beyond the
+                # replayable envelope below (heartbeats and metrics
+                # re-coalesce next interval; held hellos re-send on
+                # reconnect via _held_sent), drop, retry.
+                with self._lock:
+                    self._unacked = [
+                        m for m in msgs
+                        if m.cmd not in (P.CMD_HEARTBEAT, P.CMD_METRICS)]
                 self._drop_channel()
                 continue
+            with self._lock:
+                self._unacked = [
+                    m for m in msgs
+                    if m.cmd not in (P.CMD_HEARTBEAT, P.CMD_METRICS)]
             self.stats["batches"] += 1
             self.stats["batch_msgs"] += len(msgs)
             self._ack.wait(self.rpc_timeout)

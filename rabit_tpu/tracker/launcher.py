@@ -77,6 +77,9 @@ class LocalCluster:
         sched_mesh: str = "",
         relays: int = 0,
         relay_flush_sec: float = 0.25,
+        standby: bool = False,
+        ha_journal: str = "",
+        takeover_sec: float = 1.0,
     ):
         self.num_workers = num_workers
         self.max_restarts = max_restarts
@@ -94,6 +97,18 @@ class LocalCluster:
         self.num_relays = int(relays)
         self.relay_flush_sec = float(relay_flush_sec)
         self.relays: list = []
+        #: HA control plane (doc/ha.md): standby=True runs a warm
+        #: standby tracker in-process — the primary journals every
+        #: control-plane mutation (to ha_journal when set, else an
+        #: in-memory journal streamed over CMD_JOURNAL), workers get
+        #: both addresses in rabit_tracker_addrs, and a primary death
+        #: (run(kill_tracker_after=...) or a real crash) fails the job
+        #: over within takeover_sec instead of killing it.
+        self.use_standby = bool(standby)
+        self.ha_journal = str(ha_journal or "")
+        self.takeover_sec = float(takeover_sec)
+        self.standby = None
+        self._worker_addrs: list[tuple[str, int]] = []
         #: per-task restart / last-returncode bookkeeping, keyed by TASK ID
         #: (workers "0".."N-1", spares "s0".."sK-1") — dicts, not spawn-
         #: order lists, so elastic membership cannot index out of range.
@@ -169,6 +184,12 @@ class LocalCluster:
             # over defaults, so the worker sees rabit_spare=1 without
             # touching its argv.
             env["RABIT_TPU_RABIT_SPARE"] = "1"
+        if self._worker_addrs and not self.relays:
+            # The HA failover list (doc/ha.md): direct workers rotate
+            # through primary-then-standby; relayed workers keep their
+            # relay address — the relay's channel rotates for them.
+            env["RABIT_TPU_RABIT_TRACKER_ADDRS"] = ",".join(
+                f"{h}:{p}" for h, p in self._worker_addrs)
         return subprocess.Popen(cmd, env=env)
 
     def run(
@@ -177,6 +198,7 @@ class LocalCluster:
         timeout: float = 300.0,
         preempt: list[tuple[float, int]] | None = None,
         wedge: list[tuple[float, int]] | None = None,
+        kill_tracker_after: float | None = None,
     ) -> int:
         """Run ``cmd`` x num_workers (+ spares) under a fresh tracker.
         Returns 0 when every primary worker exited cleanly; raises on
@@ -196,19 +218,50 @@ class LocalCluster:
         stay open and its peers just block.  With heartbeat leases enabled
         (``rabit_heartbeat_sec`` on the workers) the tracker suspects the
         frozen worker, this launcher SIGKILLs it, and the hang becomes an
-        ordinary recoverable death."""
-        tracker = Tracker(self.num_workers, quiet=self.quiet,
-                          on_suspect=self._on_suspect,
-                          shrink_after_sec=self.shrink_after_sec,
-                          schedule=self.schedule,
-                          sched_mesh=self.sched_mesh).start()
+        ordinary recoverable death.
+
+        ``kill_tracker_after`` (needs ``standby=True`` to be survivable)
+        kills the PRIMARY TRACKER abruptly that many seconds in —
+        ``Tracker.kill()``, the in-process SIGKILL: every socket drops
+        with no goodbye.  The warm standby replays the journal, takes
+        over within ``takeover_sec``, and the workers fail over via
+        their ``rabit_tracker_addrs`` rotation (doc/ha.md)."""
+        tracker_kwargs = dict(quiet=self.quiet,
+                              on_suspect=self._on_suspect,
+                              shrink_after_sec=self.shrink_after_sec,
+                              schedule=self.schedule,
+                              sched_mesh=self.sched_mesh)
+        journal = None
+        if self.use_standby:
+            if self.ha_journal:
+                journal = self.ha_journal
+            else:
+                from rabit_tpu.ha import Journal
+
+                journal = Journal(None)
+        tracker = Tracker(self.num_workers, journal=journal,
+                          **tracker_kwargs).start()
         self.messages = tracker.messages
         self.events = tracker.events
+        self._worker_addrs = []
+        if self.use_standby:
+            from rabit_tpu.ha import Standby
+
+            self.standby = Standby(
+                primary=(tracker.host, tracker.port),
+                takeover_sec=self.takeover_sec,
+                journal=self.ha_journal or None,
+                tracker_kwargs=tracker_kwargs,
+                quiet=self.quiet).start()
+            self._worker_addrs = [(tracker.host, tracker.port),
+                                  (self.standby.host, self.standby.port)]
         if self.num_relays > 0:
             from rabit_tpu.relay import Relay
 
+            relay_target = (self._worker_addrs
+                            or (tracker.host, tracker.port))
             self.relays = [
-                Relay((tracker.host, tracker.port), relay_id=f"relay{i}",
+                Relay(relay_target, relay_id=f"relay{i}",
                       flush_sec=self.relay_flush_sec,
                       quiet=self.quiet).start()
                 for i in range(self.num_relays)
@@ -224,10 +277,19 @@ class LocalCluster:
         pending = sorted(preempt or [], key=lambda p: p[0], reverse=True)
         wedges = sorted(wedge or [], key=lambda p: p[0], reverse=True)
         reap_pending: set[str] = set()  # killed, reap deferred to poll loop
+        tracker_killed = False
         try:
             while True:
                 if time.monotonic() > deadline:
                     raise TimeoutError(f"cluster did not finish within {timeout}s")
+                if (kill_tracker_after is not None and not tracker_killed
+                        and time.monotonic() - start >= kill_tracker_after):
+                    tracker_killed = True
+                    tracker.kill()
+                    if not self.quiet:
+                        print("[launcher] primary tracker KILLED "
+                              "(abrupt; standby takeover pending)",
+                              flush=True)
                 while pending and time.monotonic() - start >= pending[-1][0]:
                     _, idx = pending[-1]
                     tid = str(idx)
@@ -361,8 +423,23 @@ class LocalCluster:
             for relay in self.relays:
                 relay.stop()
             self.relays = []
-            tracker.stop()  # also flushes telemetry.json (idempotent)
-            self.telemetry = tracker.telemetry
+            promoted = (self.standby.tracker
+                        if self.standby is not None
+                        and self.standby.promoted.is_set() else None)
+            if promoted is not None:
+                # The promoted standby is the job's tracker of record:
+                # its stop() (inside standby.stop) flushes telemetry,
+                # and the job timeline is the primary's events up to
+                # the cut plus the standby's from takeover.
+                self.standby.stop()
+                tracker.stop()
+                self.telemetry = promoted.telemetry
+                self.events = list(tracker.events) + list(promoted.events)
+            else:
+                if self.standby is not None:
+                    self.standby.stop()
+                tracker.stop()  # also flushes telemetry.json (idempotent)
+                self.telemetry = tracker.telemetry
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -400,6 +477,30 @@ def main(argv: list[str] | None = None) -> int:
              "empty = near-square auto dims)",
     )
     ap.add_argument(
+        "--standby", action="store_true",
+        help="run a warm-standby tracker in-process: the primary "
+             "journals every control-plane mutation, workers get both "
+             "addresses in rabit_tracker_addrs, and a primary tracker "
+             "death fails over within --takeover-sec (doc/ha.md)",
+    )
+    ap.add_argument(
+        "--ha-journal", default="", metavar="PATH",
+        help="durable journal file for the HA control plane (default: "
+             "the rabit_ha_journal config key; empty = in-memory, "
+             "streamed to the standby over CMD_JOURNAL)",
+    )
+    ap.add_argument(
+        "--takeover-sec", type=float, default=None, metavar="SEC",
+        help="the standby's takeover lease (default: the "
+             "rabit_ha_takeover_sec config key)",
+    )
+    ap.add_argument(
+        "--kill-tracker-after", type=float, default=None, metavar="SEC",
+        help="ABRUPTLY kill the primary tracker SEC seconds in (the "
+             "in-process SIGKILL; pair with --standby to prove the "
+             "failover, omit --standby to prove the job loss)",
+    )
+    ap.add_argument(
         "--preempt", action="append", default=[], metavar="DELAY:RANK",
         help="SIGKILL worker RANK DELAY seconds after launch, wherever it "
              "happens to be (repeatable; induced-preemption testing)",
@@ -434,13 +535,25 @@ def main(argv: list[str] | None = None) -> int:
 
     preempt = parse_schedule(args.preempt, "--preempt")
     wedge = parse_schedule(args.wedge, "--wedge")
+    from rabit_tpu.config import Config
+
+    cfg = Config()
+    ha_journal = args.ha_journal or cfg.get("rabit_ha_journal", "") or ""
+    takeover = (args.takeover_sec if args.takeover_sec is not None
+                else float(cfg.get("rabit_ha_takeover_sec", "1.0")
+                           or "1.0"))
     cluster = LocalCluster(args.num_workers, args.max_restarts,
                            quiet=args.quiet, spares=args.spares,
                            shrink_after_sec=args.shrink_after,
                            schedule=args.schedule,
                            sched_mesh=args.sched_mesh,
-                           relays=args.relays)
-    return cluster.run(cmd, timeout=args.timeout, preempt=preempt, wedge=wedge)
+                           relays=args.relays,
+                           standby=args.standby,
+                           ha_journal=ha_journal,
+                           takeover_sec=takeover)
+    return cluster.run(cmd, timeout=args.timeout, preempt=preempt,
+                       wedge=wedge,
+                       kill_tracker_after=args.kill_tracker_after)
 
 
 if __name__ == "__main__":

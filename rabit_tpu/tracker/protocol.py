@@ -132,6 +132,21 @@ relay <-> tracker channel (doc/scaling.md): a relay (rabit_tpu.relay)
     Frames with a task_id route a reply (an Assignment, a MAGIC_BLOB
     park frame) to that parked child connection.
 
+standby <-> tracker journal channel (doc/ha.md): a warm-standby tracker
+    (rabit_tpu.ha) establishes ONE persistent channel with the hello
+    above using ``cmd=CMD_JOURNAL`` (task_id = the standby's id; no
+    listen_port).  The tracker answers ``u32 ACK`` and then streams
+    journal frames (``put_journal_frame``): first a ``snapshot`` record
+    of the full control-plane state, then every subsequent mutation
+    record as it commits, with periodic ``tick`` keepalives so the
+    standby's takeover lease (rabit_ha_takeover_sec) can distinguish an
+    idle primary from a dead one.  Each frame reuses the durable
+    store's RTC2 layout (magic, codec byte, crc over the ENCODED
+    payload, length): magic "RJL1", then the codec-compressed JSON
+    record ``{"kind": ..., <fields>}`` — the same frames a
+    ``rabit_ha_journal`` file holds, so file tailing and channel
+    streaming replay identically (rabit_tpu/ha/journal.py).
+
 worker <-> worker link handshake (both directions on connect/accept):
     u32 MAGIC_LINK, i32 my_rank, u32 epoch
 
@@ -177,6 +192,11 @@ CMD_BATCH = 11
 #: marks the matching virtual connection dead so the wave purge counts
 #: live survivors only, exactly as _conn_dead does for direct sockets.
 CMD_HANGUP = 12
+#: Warm-standby journal channel (rabit_tpu.ha, doc/ha.md): the hello of
+#: a standby tracker asking to tail the primary's control-plane journal.
+#: The reply is ACK followed by a stream of journal frames (a snapshot
+#: record first, then every mutation as it commits).
+CMD_JOURNAL = 13
 
 #: put_route_frame flags bit 0: close the child connection after
 #: delivering this frame's payload (the tracker's "conn.close()" crossing
@@ -422,6 +442,121 @@ def read_skip_frame(sock) -> tuple[int, int, int]:
     return rank, epoch, version
 
 
+#: Journal frame header (rabit_tpu/ha, doc/ha.md): the durable store's
+#: RTC2 layout applied to control-plane mutation records — magic, codec
+#: byte (rabit_tpu.compress ids; 0 = identity), pad, crc32 over the
+#: ENCODED payload, encoded length.  Integrity is checked before any
+#: decode touches the bytes, so a torn tail record reads as ABSENT and
+#: replay truncates to the last good record instead of crashing.
+JOURNAL_MAGIC = b"RJL1"
+_JHDR = struct.Struct("<4sBxxxII")
+
+
+def put_journal_frame(kind: str, fields: dict | None = None,
+                      codec: str = "zlib") -> bytes:
+    """Encode one control-plane journal record (``{"kind": ..,
+    <fields>}`` as canonical sorted-key JSON) behind the crc'd,
+    codec-tagged RJL1 header.  The same bytes land in the
+    ``rabit_ha_journal`` file and on the CMD_JOURNAL channel."""
+    import json as _json
+
+    payload = _json.dumps({"kind": kind, **(fields or {})},
+                          sort_keys=True,
+                          separators=(",", ":")).encode()
+    codec_id = 0
+    if codec and codec != "identity":
+        from rabit_tpu.compress import get_codec
+
+        c = get_codec(codec)
+        payload = c.encode_bytes(payload)
+        codec_id = c.codec_id
+    import zlib as _zlib
+
+    return _JHDR.pack(JOURNAL_MAGIC, codec_id, _zlib.crc32(payload),
+                      len(payload)) + payload
+
+
+def read_journal_frame(sock) -> tuple[str, dict]:
+    """Read one journal frame off a blocking stream; returns ``(kind,
+    fields)``.  Raises ValueError on a bad magic / crc mismatch /
+    undecodable payload (the caller treats it as a torn tail) and
+    ConnectionError on EOF."""
+    head = recv_exact(sock, _JHDR.size)
+    magic, codec_id, crc, n = _JHDR.unpack(head)
+    if magic != JOURNAL_MAGIC:
+        raise ValueError(f"bad journal magic {magic!r}")
+    payload = recv_exact(sock, n) if n else b""
+    return decode_journal_payload(codec_id, crc, payload)
+
+
+def decode_journal_payload(codec_id: int, crc: int,
+                           payload: bytes) -> tuple[str, dict]:
+    """Shared integrity-check-then-decode of one journal payload (the
+    socket reader above and the file/buffer reader in
+    rabit_tpu/ha/journal.py both end here)."""
+    import json as _json
+    import zlib as _zlib
+
+    if _zlib.crc32(payload) != crc:
+        raise ValueError("journal frame crc mismatch")
+    if codec_id != 0:
+        from rabit_tpu.compress import get_codec_by_id
+
+        try:
+            payload = get_codec_by_id(codec_id).decode_bytes(payload)
+        except Exception as exc:  # noqa: BLE001 — unknown codec/torn stream
+            raise ValueError(f"journal frame undecodable: {exc!r}")
+    try:
+        obj = _json.loads(payload.decode())
+        kind = str(obj.pop("kind"))
+    except (ValueError, KeyError, UnicodeDecodeError) as exc:
+        raise ValueError(f"journal record malformed: {exc!r}")
+    return kind, obj
+
+
+def journal_frames_from_buffer(
+        buf: bytes) -> tuple[list[tuple[str, dict]], int, str | None]:
+    """Parse every COMPLETE journal frame at the head of ``buf``.
+    Returns ``(records, consumed_bytes, error)``: a trailing partial
+    frame is simply not consumed (stream more bytes and retry); a frame
+    that fails the magic/crc/decode checks stops parsing with ``error``
+    set and nothing past the last good record consumed — the torn-tail
+    truncation shape (doc/ha.md)."""
+    records: list[tuple[str, dict]] = []
+    off = 0
+    while len(buf) - off >= _JHDR.size:
+        magic, codec_id, crc, n = _JHDR.unpack_from(buf, off)
+        if magic != JOURNAL_MAGIC:
+            return records, off, f"bad journal magic {magic!r}"
+        if len(buf) - off - _JHDR.size < n:
+            break  # partial tail frame: wait for more bytes
+        payload = bytes(buf[off + _JHDR.size:off + _JHDR.size + n])
+        try:
+            records.append(decode_journal_payload(codec_id, crc, payload))
+        except ValueError as exc:
+            return records, off, str(exc)
+        off += _JHDR.size + n
+    return records, off, None
+
+
+def parse_addrs(spec: str) -> list[tuple[str, int]]:
+    """Parse a ``rabit_tracker_addrs`` value ("host:port,host:port",
+    primary first) into an address list for :func:`tracker_rpc`'s
+    failover rotation.  Malformed entries are skipped — a bad config
+    must degrade to the primary address, not crash a worker."""
+    out: list[tuple[str, int]] = []
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part or ":" not in part:
+            continue
+        host, _, port_s = part.rpartition(":")
+        try:
+            out.append((host, int(port_s)))
+        except ValueError:
+            continue
+    return out
+
+
 def recv_blob_frame(sock) -> tuple[int, bytes]:
     """Read one MAGIC_BLOB frame; returns (version, payload)."""
     magic = get_u32(sock)
@@ -543,8 +678,8 @@ def hello_parser():
         blob = (yield n) if n else b""
         return Hello(cmd, prev_rank, task_id, blob_version=version,
                      blob=blob)
-    # CMD_SHUTDOWN / CMD_BATCH (and anything future): the base hello is
-    # the whole message.
+    # CMD_SHUTDOWN / CMD_BATCH / CMD_JOURNAL (and anything future): the
+    # base hello is the whole message.
     return Hello(cmd, prev_rank, task_id)
 
 
@@ -639,6 +774,7 @@ def tracker_rpc(
     backoff: float = 0.1,
     backoff_cap: float = 2.0,
     rng: random.Random | None = None,
+    addrs: "list[tuple[str, int]] | None" = None,
 ) -> "Assignment | int":
     """The one resilient client path for every Python-side tracker message
     (bootstrap check-ins, print, metrics, heartbeat, shutdown).
@@ -664,11 +800,25 @@ def tracker_rpc(
     entry on re-check-in (Tracker._register).  SPARE does not ride this
     path: its connection is long-lived by design (park-then-promote; see
     rabit_tpu.elastic.client).
+
+    ``addrs`` is the HA failover list (``rabit_tracker_addrs``,
+    doc/ha.md): additional tracker addresses — a warm standby — the
+    retry loop rotates through when an attempt fails, so a primary
+    tracker death surfaces as one failed attempt followed by the same
+    RPC landing on whichever address answers, not as
+    :class:`TrackerUnreachable`.  ``(host, port)`` stays the first
+    candidate; duplicates are dropped.
     """
     rng = rng if rng is not None else random
     retries = max(int(retries), 0)
+    cands = [(host, int(port))]
+    for a in addrs or []:
+        t = (a[0], int(a[1]))
+        if t not in cands:
+            cands.append(t)
     last_err: Exception | None = None
     for attempt in range(retries + 1):
+        host, port = cands[attempt % len(cands)]
         try:
             with socket.create_connection((host, int(port)), timeout=timeout) as sock:
                 sock.settimeout(timeout)

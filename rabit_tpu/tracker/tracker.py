@@ -350,7 +350,11 @@ class Tracker:
                  quorum_flag_after: int = 3,
                  reactor: bool = True,
                  backlog: int | None = None,
-                 max_messages: int = 4096):
+                 max_messages: int = 4096,
+                 journal=None,
+                 resume_from=None,
+                 listen_sock: socket.socket | None = None,
+                 ha_tick_sec: float | None = None):
         #: CURRENT world size — mutable under elastic membership (shrink/
         #: grow); ``base_world`` is the launch size and grow-back target.
         self.world_size = world_size
@@ -430,10 +434,17 @@ class Tracker:
         if backlog is None:
             backlog = Config().get_int("rabit_tracker_backlog", 1024)
         self.backlog = max(int(backlog), 1)
-        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self._srv.bind((host, port))
-        self._srv.listen(self.backlog)
+        if listen_sock is not None:
+            # HA takeover (rabit_tpu.ha.Standby): the standby pre-bound
+            # its advertised address; listen() here is the moment it
+            # starts answering the client-side failover rotation.
+            self._srv = listen_sock
+            self._srv.listen(self.backlog)
+        else:
+            self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            self._srv.bind((host, port))
+            self._srv.listen(self.backlog)
         self.host, self.port = self._srv.getsockname()
         self._lock = threading.Lock()
         self._pending: list[_Pending] = []
@@ -464,6 +475,86 @@ class Tracker:
         self._stats_lock = threading.Lock()
         self._handler_threads = 0
         self._relay_channels: list[_RelayChannel] = []
+        # HA control plane (rabit_tpu/ha, doc/ha.md): the durable state
+        # journal (every control-plane mutation appended as a framed,
+        # crc'd record; a path string opens a file journal), the state a
+        # promoted tracker resumes from, and the abrupt-death flag the
+        # chaos harness flips.  journal=None disables journaling; a
+        # CMD_JOURNAL standby then gets refused instead of silently
+        # syncing nothing.
+        self._killed = False
+        self._journal_conns: list[socket.socket] = []
+        if isinstance(journal, str):
+            from rabit_tpu.ha.journal import Journal
+
+            cfg = Config()
+            journal = Journal(
+                journal,
+                snapshot_every=cfg.get_int("rabit_ha_snapshot_every", 256))
+        self.journal = journal
+        if self.journal is not None:
+            self.journal.on_event = self._journal_event
+        self._ha_tick_sec = (float(ha_tick_sec) if ha_tick_sec is not None
+                             else float(Config().get("rabit_ha_tick_sec",
+                                                     "0.25") or "0.25"))
+        if resume_from is not None:
+            self._adopt_state(resume_from)
+        self._journal("init", base_world=self.base_world)
+
+    # -- HA journal seams (rabit_tpu/ha, doc/ha.md) ------------------------
+
+    def _journal(self, kind: str, **fields) -> None:
+        """Append one control-plane mutation record.  Non-blocking (the
+        journal's writer thread does the IO), so safe at every mutation
+        point — including under self._lock."""
+        if self.journal is not None:
+            self.journal.append(kind, **fields)
+
+    def _journal_event(self, ev: dict) -> None:
+        """Journal-writer telemetry (journal_snapshot / journal_gap)
+        folded into the tracker's event timeline."""
+        with self._lock:
+            self.events.append({"ts": round(time.time(), 6), **ev})
+
+    def _adopt_state(self, st) -> None:
+        """Seed this tracker from a replayed ControlState (a standby's
+        takeover): stable ranks, the membership epoch line, frozen
+        quorum records, link flags, the spare-pool roster and admission
+        counters all survive the failover, so every wave the new
+        primary closes is the wave the old one would have closed.
+        Journaled leases re-arm with FRESH deadlines — a worker that
+        died during the cut still gets suspected, one takeover lease
+        late, while live workers renew well before that."""
+        self.base_world = int(st.base_world) or self.base_world
+        self.world_size = int(st.world) or self.world_size
+        self.elastic.base_world = self.base_world
+        if st.epoch >= 0:
+            self.elastic.restore(st.epoch, st.world, st.rank_map,
+                                 history=[tuple(e) for e in st.epochs])
+        self._ranks.update(st.ranks)
+        self._n_starts.update(st.n_starts)
+        self._shutdown_tasks |= set(st.shutdown)
+        self._n_shutdown = len(self._shutdown_tasks)
+        self._link_flags |= {tuple(p) for p in st.link_flags}
+        self._last_ring = list(st.last_ring)
+        if self._quorum is not None:
+            self._quorum.seed(st.quorum_seed())
+        now = time.monotonic()
+        for task_id, (interval, rank) in sorted(st.leases.items()):
+            if task_id not in self._shutdown_tasks:
+                self._leases[task_id] = _Lease(
+                    now + P.LEASE_FACTOR * float(interval),
+                    float(interval), int(rank))
+        # The bootstrap-blob BYTES are deliberately not journaled (only
+        # the version, via spare_park records): rank 0 re-ships the blob
+        # after its next commit, and a pre-failover spare already holds
+        # its copy.
+
+    def _drop_lease_locked(self, task_id: str) -> None:
+        """Drop a lease (re-check-in, shutdown, park) and journal the
+        drop exactly when one existed.  Caller holds self._lock."""
+        if self._leases.pop(task_id, None) is not None:
+            self._journal("lease_drop", task_id=task_id)
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -498,13 +589,58 @@ class Tracker:
             pass
         with self._lock:
             channels, self._relay_channels = self._relay_channels, []
+            jconns, self._journal_conns = self._journal_conns, []
         for ch in channels:
             ch.close()
+        for conn in jconns:
+            try:
+                conn.close()
+            except OSError:
+                pass
         self._release_spares()
         # Safety net for jobs torn down without a full shutdown wave (kill,
         # timeout): idempotent, so the normal all-ranks-shut-down path has
         # already written by the time stop() runs.
         self.write_telemetry()
+        if self.journal is not None:
+            self.journal.close()
+
+    def kill(self) -> None:
+        """ABRUPT death — the in-process analog of SIGKILL, for the HA
+        chaos campaigns (doc/ha.md): every socket drops with no goodbye
+        (parked waves, spare pool, relay and journal channels, the
+        listener), no telemetry is written, and the journal's writer
+        stops wherever it was.  Workers see resets and fail over via
+        their rabit_tracker_addrs rotation; the standby's journal
+        channel EOFs and its takeover lease starts running."""
+        self._killed = True
+        with self._lock:
+            self._telemetry_written = True  # a SIGKILL leaves no gasp
+        self._done.set()
+        try:
+            self._srv.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+        with self._lock:
+            channels, self._relay_channels = self._relay_channels, []
+            jconns, self._journal_conns = self._journal_conns, []
+            held = [p.conn for p in self._pending] + \
+                   [s.conn for s in self._spares]
+            self._pending, self._spares = [], []
+            self._pending_ids = set()
+        for ch in channels:
+            ch.close()
+        for conn in jconns + held:
+            try:
+                conn.close()
+            except OSError:
+                pass
+        if self.journal is not None:
+            self.journal.close()
 
     def _release_spares(self) -> None:
         """Release parked spares: their warm sockets EOF and the spare
@@ -574,7 +710,7 @@ class Tracker:
                     # A (re-)check-in supersedes any lease of the previous
                     # life: the fresh worker renews once it is back up, and
                     # a stale lease must not re-suspect it mid-bootstrap.
-                    self._leases.pop(task_id, None)
+                    self._drop_lease_locked(task_id)
                 self._register(conn, addr[0], task_id, listen_port, prev_rank,
                                cmd)
                 # conn is answered (and closed) by the wave completer.
@@ -591,6 +727,12 @@ class Tracker:
                 # thread BECOMES the channel server.
                 conn.settimeout(None)
                 self._serve_relay(conn, task_id, addr)
+                return
+            if cmd == P.CMD_JOURNAL:
+                # A warm standby tailing the control-plane journal
+                # (rabit_tpu.ha, doc/ha.md): this thread streams frames.
+                conn.settimeout(None)
+                self._serve_journal(conn, task_id)
                 return
             hello = P.Hello(cmd, prev_rank, task_id)
             if cmd == P.CMD_BLOB:
@@ -639,6 +781,7 @@ class Tracker:
             with self._lock:
                 if self._blob is None or h.blob_version >= self._blob[0]:
                     self._blob = (h.blob_version, h.blob)
+                    self._journal("blob", version=h.blob_version)
                 self.events.append({
                     "ts": round(time.time(), 6),
                     "kind": "bootstrap_blob", "task_id": h.task_id,
@@ -665,7 +808,7 @@ class Tracker:
                 # A clean exit must not be suspected afterwards; drop
                 # the lease BEFORE acking so the worker observing the
                 # ACK observes the drop too.
-                self._leases.pop(h.task_id, None)
+                self._drop_lease_locked(h.task_id)
             return P.put_u32(P.ACK), lambda: self._note_shutdown(h.task_id)
         raise ValueError(f"unknown tracker cmd {h.cmd}")
 
@@ -673,8 +816,14 @@ class Tracker:
         """Post-ACK shutdown bookkeeping (the completion guard)."""
         done = False
         with self._lock:
-            self._n_shutdown += 1
-            self._shutdown_tasks.add(task_id)
+            # Idempotent by task id: a relay replaying its un-ACKed
+            # batch across a failover cut (doc/ha.md) may deliver the
+            # same shutdown twice, and a double count could close the
+            # completion guard early.
+            if task_id not in self._shutdown_tasks:
+                self._n_shutdown += 1
+                self._shutdown_tasks.add(task_id)
+                self._journal("shutdown", task_id=task_id)
             # Elastic guard on the completion condition: a shrunk
             # world can reach n_shutdown >= world_size while OTHER
             # workers still hold live leases (they detected the
@@ -836,7 +985,7 @@ class Tracker:
             if h.cmd in (P.CMD_START, P.CMD_RECOVER):
                 self._reactor_detach(sel, conns, rc)
                 with self._lock:
-                    self._leases.pop(h.task_id, None)
+                    self._drop_lease_locked(h.task_id)
                 self._register(rc.sock, rc.addr[0], h.task_id,
                                h.listen_port, h.prev_rank, h.cmd,
                                async_send=True)
@@ -860,6 +1009,14 @@ class Tracker:
                     args=(rc.sock, h.task_id, rc.addr, rest),
                     daemon=True,
                     name=f"rabit-relay-rx-{h.task_id}").start()
+                return
+            if h.cmd == P.CMD_JOURNAL:
+                self._reactor_detach(sel, conns, rc)
+                threading.Thread(
+                    target=self._serve_journal,
+                    args=(rc.sock, h.task_id),
+                    daemon=True,
+                    name=f"rabit-ha-tx-{h.task_id}").start()
                 return
             reply, post = self._short_rpc_reply(h)
         except (ValueError, OSError):
@@ -889,6 +1046,61 @@ class Tracker:
                 return
             del rc.out[:n]
         self._reactor_drop(sel, conns, rc)
+
+    # -- journal channels (rabit_tpu.ha; doc/ha.md) ------------------------
+
+    def _serve_journal(self, conn: socket.socket, standby_id: str) -> None:
+        """Stream the control-plane journal to a warm standby: ACK the
+        hello, then forward every frame the journal's writer fans out —
+        a snapshot of the current state first (Journal.subscribe seeds
+        it), then each mutation record in commit order, with the
+        periodic ``tick`` records doubling as the keepalive the
+        standby's takeover lease watches.  A tracker with no journal
+        configured REFUSES the channel (closes without ACK): silently
+        streaming nothing would let a misconfigured standby 'sync' an
+        empty state and take over with it."""
+        if self.journal is None:
+            if not self.quiet:
+                print(f"[tracker] standby {standby_id} asked for the "
+                      f"journal but journaling is off (pass journal= / "
+                      f"rabit_ha_journal); refusing", flush=True)
+            try:
+                conn.close()
+            except OSError:
+                pass
+            return
+        try:
+            conn.sendall(P.put_u32(P.ACK))
+        except OSError:
+            try:
+                conn.close()
+            except OSError:
+                pass
+            return
+        sub = self.journal.subscribe()
+        with self._lock:
+            self._journal_conns.append(conn)
+        if not self.quiet:
+            print(f"[tracker] standby {standby_id} journal channel up",
+                  flush=True)
+        try:
+            while not self._done.is_set():
+                try:
+                    frame = sub.get(timeout=0.25)
+                except queue.Empty:
+                    continue
+                conn.sendall(frame)
+        except OSError:
+            pass
+        finally:
+            self.journal.unsubscribe(sub)
+            with self._lock:
+                if conn in self._journal_conns:
+                    self._journal_conns.remove(conn)
+            try:
+                conn.close()
+            except OSError:
+                pass
 
     # -- relay channels (rabit_tpu.relay; doc/scaling.md) ------------------
 
@@ -965,7 +1177,7 @@ class Tracker:
             if m.cmd in (P.CMD_START, P.CMD_RECOVER):
                 vconn = _RelayedConn(channel, m.task_id)
                 with self._lock:
-                    self._leases.pop(m.task_id, None)
+                    self._drop_lease_locked(m.task_id)
                 self._register(vconn, m.host, m.task_id, m.listen_port,
                                m.prev_rank, m.cmd, async_send=True)
             elif m.cmd == P.CMD_SPARE:
@@ -980,8 +1192,21 @@ class Tracker:
                 self._log_print(m.payload.decode())
             elif m.cmd == P.CMD_SHUTDOWN:
                 with self._lock:
-                    self._leases.pop(m.task_id, None)
+                    self._drop_lease_locked(m.task_id)
                 self._note_shutdown(m.task_id)
+            elif m.cmd == P.CMD_QUORUM:
+                # A quorum-round report folded through the batch
+                # envelope (the PR 9 follow-on: a quorum-heavy world no
+                # longer costs the root one connection per rank per
+                # round).  The frozen record routes back to the child
+                # parked at the relay under its ``q#``-prefixed key —
+                # the reply bytes are exactly the direct path's
+                # (ACK + record JSON), and re-delivery after a channel
+                # cut is safe because the table decides once.
+                reply = self._quorum_report(m.payload.decode())
+                channel.send_route(
+                    m.task_id, P.ROUTE_CLOSE,
+                    P.put_u32(P.ACK) + P.put_str(json.dumps(reply)))
             elif m.cmd == P.CMD_HANGUP:
                 # The relay saw a parked child's connection EOF: make its
                 # virtual connection read as hung up so the wave purge
@@ -991,9 +1216,9 @@ class Tracker:
                 if vconn is not None:
                     vconn.child_dead = True
             # CMD_EPOCH never rides a batch (the relay answers polls from
-            # its ack-refreshed cache); CMD_QUORUM and CMD_BLOB are
-            # proxied straight through by the relay (decide-once replies
-            # and rank-0 blob uploads need the synchronous path).
+            # its ack-refreshed cache); CMD_BLOB is proxied straight
+            # through by the relay (rank-0 blob uploads are large and
+            # rare — they keep the synchronous path).
         except (ValueError, UnicodeDecodeError):
             pass  # one malformed sub-message must not hurt the batch
         return ts
@@ -1045,7 +1270,7 @@ class Tracker:
         and park its connection in the pool.  The warm socket is answered
         with an Assignment when the spare is promoted into a wave."""
         with self._lock:
-            self._leases.pop(task_id, None)
+            self._drop_lease_locked(task_id)
             version, blob = self._blob if self._blob is not None else (0, b"")
         try:
             conn.sendall(P.put_blob_frame(version, blob))
@@ -1065,6 +1290,8 @@ class Tracker:
             self._spares.append(_Pending(conn, task_id, listen_port, host,
                                          prev_rank, P.CMD_START,
                                          origin="spare"))
+            self._journal("spare_park", task_id=task_id,
+                          blob_version=version)
             self.events.append({
                 "ts": round(time.time(), 6), "kind": "spare_parked",
                 "task_id": task_id, "blob_version": version,
@@ -1097,11 +1324,23 @@ class Tracker:
                 # A worker a wave behind: its round will be redone under
                 # the new epoch — never decide against a stale world.
                 return {"decided": False, "stale_epoch": True}
+            known = self._quorum.has_record(epoch, version)
             rec, events, flag_ranks = self._quorum.report(
                 epoch, version, self.world_size, have, held)
             ts = round(time.time(), 6)
             for ev in events:
                 self.events.append({"ts": ts, **ev})
+                if ev["kind"] == "contribution_late":
+                    self._journal("quorum_late",
+                                  src_version=ev["src_version"],
+                                  rank=ev["rank"])
+            if not known and rec.get("decided"):
+                # This report FROZE the round's record: the frozen dict
+                # is law on every rank, so it must survive a failover
+                # byte-for-byte (doc/ha.md, doc/partial_allreduce.md).
+                self._journal("quorum_freeze", epoch=epoch,
+                              version=version, world=self.world_size,
+                              record=dict(rec))
             order = self._last_ring or list(range(self.world_size))
             pos = {r: i for i, r in enumerate(order)}
             for r in flag_ranks:
@@ -1144,6 +1383,8 @@ class Tracker:
                 return
             self._link_flags |= fresh
             self._repair_wanted = True
+            for src_t, dst_t in sorted(fresh):
+                self._journal("link_flag", src=src_t, dst=dst_t)
         if not self.quiet:
             print(f"[tracker] link {src}->{dst} flagged degraded; repair "
                   f"replan armed", flush=True)
@@ -1201,6 +1442,8 @@ class Tracker:
             except OSError:
                 pass
         self._spares = [s for s in self._spares if s not in dead]
+        self._journal("spare_drop",
+                      task_ids=sorted(s.task_id for s in dead))
         self.events.append({
             "ts": round(time.time(), 6), "kind": "spare_dropped",
             "dropped": sorted(s.task_id for s in dead),
@@ -1320,6 +1563,17 @@ class Tracker:
                 "from": prev_world, "to": world,
                 "joined": sorted(delta["joined"]),
             })
+        # The wave is THE control-plane commit: one journal record
+        # carries everything a standby needs to close the same waves
+        # (rank line, admission counters, promoted spares) — and its
+        # epoch boundary settles the replayed quorum ledger exactly as
+        # epoch_changed settled the live one (doc/ha.md).
+        self._journal(
+            "wave", epoch=wepoch.epoch, world=world,
+            rank_map=dict(rank_map),
+            started=sorted(p.task_id for p in members
+                           if p.cmd == P.CMD_START),
+            promoted=sorted(promoted))
         return {"members": members, "world": world, "epoch": wepoch.epoch,
                 "rank_map": rank_map, "surplus": surplus,
                 "promoted": promoted, "resized": decision.resized}
@@ -1372,6 +1626,8 @@ class Tracker:
         with self._lock:
             self._last_ring = (list(splan.ring_order)
                                or list(range(world)))
+            self._journal("sched", epoch=plan["epoch"], algo=splan.algo,
+                          ring=list(self._last_ring))
             self.events.append({
                 "ts": ts, "kind": "schedule_planned",
                 "epoch": plan["epoch"], "algo": splan.algo, "world": world,
@@ -1448,6 +1704,8 @@ class Tracker:
             p.cmd = P.CMD_START
             with self._lock:
                 self._spares.append(p)
+                self._journal("spare_park", task_id=p.task_id,
+                              blob_version=version)
                 self.events.append({
                     "ts": round(time.time(), 6), "kind": "spare_parked",
                     "task_id": p.task_id, "blob_version": version,
@@ -1467,20 +1725,37 @@ class Tracker:
         if not (0 < interval < 86400):
             return
         with self._lock:
+            prev = self._leases.get(task_id)
             self._leases[task_id] = _Lease(
                 time.monotonic() + P.LEASE_FACTOR * interval, interval, rank)
+            # Journal GRANTS (and identity changes), not every renewal:
+            # the replayable fact is "this task holds a lease of this
+            # interval at this rank" — deadlines are wall-clock and
+            # re-arm fresh at takeover (doc/ha.md).
+            if prev is None or prev.interval != interval \
+                    or prev.rank != rank:
+                self._journal("lease", task_id=task_id,
+                              interval=interval, rank=rank)
 
     def _lease_monitor(self) -> None:
         """Scan leases and suspect the silent.  An expired lease is removed
         before ``on_suspect`` fires, so one hang produces exactly one
         suspicion (the restarted life re-establishes its own lease)."""
+        next_tick = time.monotonic() + self._ha_tick_sec
         while not self._done.wait(0.05):
             now = time.monotonic()
+            if self.journal is not None and now >= next_tick:
+                # The HA keepalive: a tick record proves the primary is
+                # alive to file-tailing AND streaming standbys, so an
+                # idle job never looks dead (doc/ha.md).
+                next_tick = now + self._ha_tick_sec
+                self._journal("tick")
             expired: list[tuple[str, _Lease]] = []
             with self._lock:
                 for task_id, lease in list(self._leases.items()):
                     if now >= lease.expires:
                         del self._leases[task_id]
+                        self._journal("lease_drop", task_id=task_id)
                         expired.append((task_id, lease))
                 for task_id, lease in expired:
                     self.events.append({

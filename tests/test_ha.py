@@ -1,0 +1,709 @@
+"""HA control plane (ISSUE 10, doc/ha.md): journaled tracker state,
+warm-standby failover, survivable mid-wave tracker death.
+
+Layers covered, bottom-up:
+
+* journal wire units: the crc'd codec-tagged RJL1 frame (socket and
+  buffer decoders), torn-tail truncation, the ``rabit_tracker_addrs``
+  parser, and ``tracker_rpc``'s address-list rotation;
+* the replay determinism gate: for seeded arbitrary mutation
+  sequences, file replay == the journal's live mirror, byte-compared
+  (plus snapshot round-trip idempotence and compaction);
+* standby sync: streamed (CMD_JOURNAL snapshot + live records) and
+  file-tailed, the takeover lease, state preservation across the
+  promotion (ranks, epochs, frozen quorum records answered
+  identically), and the no-journal refusal;
+* e2e: an elastic job survives an ABRUPT primary-tracker kill
+  mid-wave and mid-run — in-thread and at process level
+  (``LocalCluster(standby=True)``) — with bitwise-identical results
+  and no spurious ``lease_expired`` for live ranks;
+* relays: the channel rotates to the promoted root, replays its
+  un-ACKed envelope, and CMD_QUORUM now rides the batch (the PR 9
+  follow-on) — the root's accept count stays O(relays) under quorum;
+* chaos: the seeded failover campaign (primary killed mid-bootstrap /
+  mid-run / mid-quorum-round / mid-shrink-wave; standby death as the
+  control arm) and the ``recovery_bench --failover`` gate.
+"""
+
+import json
+import os
+import random
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from rabit_tpu.chaos import FaultSpec, run_elastic_schedule
+from rabit_tpu.elastic.client import ElasticWorker
+from rabit_tpu.elastic.membership import MembershipManager
+from rabit_tpu.elastic.rebalance import shard_slice
+from rabit_tpu.ha import ControlState, Journal, Standby, read_journal, replay
+from rabit_tpu.quorum import QuorumTable
+from rabit_tpu.tracker import protocol as P
+from rabit_tpu.tracker.tracker import Tracker
+
+
+# -- journal wire units -------------------------------------------------------
+
+@pytest.mark.parametrize("codec", ["", "zlib"])
+def test_journal_frame_round_trip(codec):
+    frame = P.put_journal_frame(
+        "wave", {"epoch": 3, "world": 2, "rank_map": {"0": 0, "1": 1}},
+        codec=codec)
+    a, b = socket.socketpair()
+    try:
+        a.sendall(frame)
+        kind, fields = P.read_journal_frame(b)
+    finally:
+        a.close()
+        b.close()
+    assert kind == "wave"
+    assert fields == {"epoch": 3, "world": 2,
+                      "rank_map": {"0": 0, "1": 1}}
+
+
+def test_journal_frame_crc_guard():
+    frame = bytearray(P.put_journal_frame("lease", {"task_id": "7"}))
+    frame[-1] ^= 0xFF  # flip a payload bit: the crc must catch it
+    a, b = socket.socketpair()
+    try:
+        a.sendall(bytes(frame))
+        with pytest.raises(ValueError):
+            P.read_journal_frame(b)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_journal_frames_from_buffer_partial_and_bad():
+    f1 = P.put_journal_frame("tick", {})
+    f2 = P.put_journal_frame("shutdown", {"task_id": "2"})
+    # a trailing partial frame is NOT consumed
+    recs, consumed, err = P.journal_frames_from_buffer(f1 + f2[:5])
+    assert [k for k, _ in recs] == ["tick"] and consumed == len(f1)
+    assert err is None
+    # garbage after a good record stops with an error at the boundary
+    recs, consumed, err = P.journal_frames_from_buffer(
+        f1 + b"\xde\xad\xbe\xef" * 4)
+    assert [k for k, _ in recs] == ["tick"] and consumed == len(f1)
+    assert err is not None
+
+
+def test_parse_addrs():
+    assert P.parse_addrs("127.0.0.1:9091,10.0.0.2:9092") == [
+        ("127.0.0.1", 9091), ("10.0.0.2", 9092)]
+    assert P.parse_addrs("") == []
+    # malformed entries degrade, not crash
+    assert P.parse_addrs("nonsense,1.2.3.4:80,:x") == [("1.2.3.4", 80)]
+
+
+def test_tracker_rpc_rotates_to_standby_address():
+    """A dead first address must cost one attempt, not the RPC: the
+    retry loop rotates through ``addrs`` (doc/ha.md)."""
+    tracker = Tracker(1, quiet=True).start()
+    # a bound-but-not-listening socket == the pre-takeover standby shape
+    dead = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    dead.bind(("127.0.0.1", 0))
+    dead_addr = dead.getsockname()
+    try:
+        ack = P.tracker_rpc(
+            dead_addr[0], dead_addr[1], P.CMD_PRINT, "t", message="hi",
+            timeout=0.5, retries=2, backoff=0.01,
+            addrs=[dead_addr, (tracker.host, tracker.port)])
+        assert ack == P.ACK
+    finally:
+        dead.close()
+        tracker.stop()
+
+
+# -- replay determinism -------------------------------------------------------
+
+def _random_records(seed: int, n: int = 60) -> list:
+    """A seeded arbitrary-but-valid mutation sequence over every record
+    kind the tracker journals."""
+    rng = random.Random(seed)
+    world = rng.choice([2, 3, 4])
+    recs = [("init", {"base_world": world})]
+    epoch = -1
+    for _ in range(n):
+        roll = rng.random()
+        if roll < 0.12:
+            epoch += 1
+            w = rng.randint(max(1, world - 1), world + 1)
+            recs.append(("wave", {
+                "epoch": epoch, "world": w,
+                "rank_map": {str(i): i for i in range(w)},
+                "started": [str(i) for i in range(w) if rng.random() < 0.7],
+                "promoted": ([f"s{rng.randint(0, 2)}"]
+                             if rng.random() < 0.3 else []),
+            }))
+        elif roll < 0.3:
+            recs.append(("lease", {"task_id": str(rng.randint(0, world)),
+                                   "interval": rng.choice([0.1, 0.25, 0.5]),
+                                   "rank": rng.randint(-1, world - 1)}))
+        elif roll < 0.4:
+            recs.append(("lease_drop",
+                         {"task_id": str(rng.randint(0, world))}))
+        elif roll < 0.5:
+            recs.append(("spare_park", {"task_id": f"s{rng.randint(0, 2)}",
+                                        "blob_version": rng.randint(0, 5)}))
+        elif roll < 0.56:
+            recs.append(("spare_drop",
+                         {"task_ids": [f"s{rng.randint(0, 2)}"]}))
+        elif roll < 0.64:
+            recs.append(("shutdown",
+                         {"task_id": str(rng.randint(0, world))}))
+        elif roll < 0.7:
+            recs.append(("link_flag", {"src": str(rng.randint(0, world)),
+                                       "dst": str(rng.randint(0, world))}))
+        elif roll < 0.76:
+            order = list(range(world))
+            rng.shuffle(order)
+            recs.append(("sched", {"epoch": max(epoch, 0),
+                                   "algo": rng.choice(["tree", "swing"]),
+                                   "ring": order}))
+        elif roll < 0.82:
+            v = rng.randint(1, 6)
+            excl = [r for r in range(world) if rng.random() < 0.3]
+            recs.append(("quorum_freeze", {
+                "epoch": max(epoch, 0), "version": v, "world": world,
+                "record": {"decided": True, "epoch": max(epoch, 0),
+                           "version": v, "k": world - len(excl),
+                           "excluded": excl, "corrections": []},
+            }))
+        elif roll < 0.86:
+            recs.append(("quorum_late", {"src_version": rng.randint(1, 6),
+                                         "rank": rng.randint(0, world - 1)}))
+        elif roll < 0.92:
+            recs.append(("blob", {"version": rng.randint(0, 8)}))
+        else:
+            recs.append(("tick", {}))
+    return recs
+
+
+@pytest.mark.parametrize("seed", [11, 22, 33, 44])
+def test_replay_determinism_gate(seed, tmp_path):
+    """The gate (doc/ha.md): for ANY recorded mutation sequence, replay
+    of the journal file lands byte-identical to the live mirror — and a
+    snapshot round-trips to the same bytes."""
+    path = str(tmp_path / "journal.bin")
+    j = Journal(path, snapshot_every=10_000)  # no compaction mid-test
+    recs = _random_records(seed)
+    for kind, fields in recs:
+        j.append(kind, **fields)
+    assert j.flush(10.0)
+    mirror = j.state_bytes()
+    file_records, torn = read_journal(path)
+    assert not torn
+    replayed = replay(file_records)
+    assert replayed.snapshot_bytes() == mirror
+    # snapshot round-trip is idempotent
+    again = ControlState.from_snapshot(replayed.snapshot())
+    assert again.snapshot_bytes() == mirror
+    j.close()
+
+
+def test_torn_tail_truncation_recovery(tmp_path):
+    """A torn tail record (the crash shape fsync-less appends allow)
+    reads as ABSENT: replay recovers the intact prefix and reopening
+    the journal compacts a clean snapshot head over the damage."""
+    path = str(tmp_path / "journal.bin")
+    j = Journal(path, snapshot_every=10_000)
+    j.append("init", base_world=2)
+    j.append("wave", epoch=0, world=2, rank_map={"0": 0, "1": 1},
+             started=["0", "1"], promoted=[])
+    assert j.flush(10.0)
+    prefix = j.state_bytes()
+    j.close()
+    with open(path, "ab") as f:  # a frame torn mid-write
+        f.write(P.put_journal_frame("shutdown", {"task_id": "0"})[:9])
+    records, torn = read_journal(path)
+    assert torn
+    assert replay(records).snapshot_bytes() == prefix
+    # reopening replays the prefix, notes the gap, compacts
+    events = []
+    j2 = Journal(path, snapshot_every=10_000, on_event=events.append)
+    assert j2.state_bytes() == prefix
+    assert any(e["kind"] == "journal_gap" for e in events)
+    assert any(e["kind"] == "journal_snapshot" for e in events)
+    j2.close()
+    records, torn = read_journal(path)
+    assert not torn and records[0][0] == "snapshot"
+    assert replay(records).snapshot_bytes() == prefix
+
+
+def test_snapshot_compaction_round_trip(tmp_path):
+    """After snapshot_every records the file is rewritten as one
+    snapshot head — replay stays O(live state), same bytes."""
+    path = str(tmp_path / "journal.bin")
+    events = []
+    j = Journal(path, snapshot_every=8, on_event=events.append)
+    for kind, fields in _random_records(5, n=30):
+        j.append(kind, **fields)
+    assert j.flush(10.0)
+    assert j.n_snapshots >= 3
+    records, torn = read_journal(path)
+    assert not torn
+    assert records[0][0] == "snapshot"
+    assert len(records) <= 8 + 1  # snapshot head + at most one window
+    assert replay(records).snapshot_bytes() == j.state_bytes()
+    assert sum(1 for e in events if e["kind"] == "journal_snapshot") \
+        == j.n_snapshots
+    j.close()
+
+
+def test_control_state_wave_settles_quorum_ledger():
+    """A wave (epoch boundary) drops outstanding corrections and prunes
+    old-epoch records — mirroring QuorumTable.epoch_changed."""
+    st = ControlState()
+    st.apply("init", {"base_world": 2})
+    st.apply("quorum_freeze", {
+        "epoch": 0, "version": 2, "world": 2,
+        "record": {"decided": True, "epoch": 0, "version": 2, "k": 1,
+                   "excluded": [1], "corrections": []}})
+    assert st.q_outstanding == {"2:1": 2}
+    st.apply("wave", {"epoch": 1, "world": 2,
+                      "rank_map": {"0": 0, "1": 1}, "started": [],
+                      "promoted": []})
+    assert st.q_outstanding == {}
+    assert st.q_records == {}  # epoch-0 record pruned at epoch 1
+
+
+def test_membership_restore_continues_epoch_line():
+    m = MembershipManager(3)
+    m.restore(4, 2, {"0": 0, "1": 1}, history=[(3, 3), (4, 2)])
+    assert m.epoch == 4 and m.world == 2
+    we, _delta = m.commit({"0": 0, "1": 1, "s0": 2}, 3)
+    assert we.epoch == 5  # monotonic continuation, never reused
+
+
+# -- standby sync + takeover --------------------------------------------------
+
+def _mk_primary(**kw):
+    kw.setdefault("quiet", True)
+    kw.setdefault("journal", Journal(None))
+    return Tracker(2, **kw).start()
+
+
+def test_standby_stream_sync_byte_identical():
+    tracker = _mk_primary()
+    standby = Standby(primary=(tracker.host, tracker.port),
+                      takeover_sec=30.0, poll_sec=0.05).start()
+    try:
+        assert standby.wait_synced(5.0)
+        tracker._renew_lease("0", 0, "0.25")
+        tracker._renew_lease("1", 1, "0.25")
+        tracker.flag_link(0, 1)  # no rank map yet: telemetry only
+        assert tracker.journal.flush(5.0)
+        deadline = time.monotonic() + 5.0
+        want = tracker.journal.state_bytes()
+        while (standby.state.snapshot_bytes() != want
+               and time.monotonic() < deadline):
+            time.sleep(0.02)
+            want = tracker.journal.state_bytes()
+        assert standby.state.snapshot_bytes() == want
+        assert any(e["kind"] == "standby_synced" for e in standby.events)
+        assert not standby.promoted.is_set()
+    finally:
+        standby.stop()
+        tracker.stop()
+
+
+def test_standby_file_tail_and_takeover(tmp_path):
+    """File transport: the standby tails the rabit_ha_journal file; the
+    primary's tick records are the liveness signal, and a killed
+    primary (ticks stop) trips the takeover lease."""
+    path = str(tmp_path / "journal.bin")
+    tracker = _mk_primary(journal=path, ha_tick_sec=0.05)
+    standby = Standby(journal_path=path, takeover_sec=0.6,
+                      poll_sec=0.05, standby_id="filetail").start()
+    try:
+        assert standby.wait_synced(5.0)
+        tracker._renew_lease("0", 0, "0.25")
+        tracker.kill()
+        assert standby.wait_promoted(8.0)
+        promoted = standby.tracker
+        assert promoted is not None
+        assert promoted.port == standby.port
+        # the journaled lease re-armed on the promoted tracker
+        assert "0" in promoted._leases
+        kinds = [e["kind"] for e in promoted.events]
+        assert "tracker_failover" in kinds and "standby_synced" in kinds
+    finally:
+        standby.stop()
+
+
+def test_takeover_preserves_control_state():
+    """Ranks, the epoch line, admission counters, and FROZEN QUORUM
+    RECORDS survive the promotion — a re-asked round gets the byte-same
+    record from the new primary (the bitwise-fold contract)."""
+    tracker = _mk_primary(quorum="0.5")
+    report = json.dumps({"epoch": 0, "v": 1, "have": [0], "held": []})
+    results = {}
+
+    def boot(tid):
+        results[tid] = P.tracker_rpc(
+            tracker.host, tracker.port, P.CMD_START, tid,
+            listen_port=41000 + int(tid), timeout=5.0, reply_timeout=10.0)
+
+    threads = [threading.Thread(target=boot, args=(t,), daemon=True)
+               for t in ("0", "1")]
+    standby = Standby(primary=(tracker.host, tracker.port),
+                      takeover_sec=0.5, poll_sec=0.05,
+                      tracker_kwargs={"quorum": "0.5"}).start()
+    try:
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(10.0)
+        rec = P.tracker_rpc(tracker.host, tracker.port, P.CMD_QUORUM, "0",
+                            message=report, timeout=5.0)
+        assert rec["decided"] and rec["excluded"] == [1]
+        assert standby.wait_synced(5.0)
+        assert tracker.journal.flush(5.0)
+        time.sleep(0.3)  # let the freeze record reach the standby
+        tracker.kill()
+        assert standby.wait_promoted(8.0)
+        promoted = standby.tracker
+        # the epoch line continues and the stable ranks survive
+        assert promoted.elastic.epoch == 0
+        assert promoted._ranks == {"0": results["0"].rank,
+                                   "1": results["1"].rank}
+        assert promoted._n_starts == {"0": 1, "1": 1}
+        # the SAME frozen record answers the re-asked round
+        rec2 = P.tracker_rpc(promoted.host, promoted.port, P.CMD_QUORUM,
+                             "1", message=report, timeout=5.0)
+        assert rec2 == rec
+    finally:
+        standby.stop()
+        tracker.stop()
+
+
+def test_promoted_journal_not_double_applied(tmp_path):
+    """A promoted tracker continuing the SAME journal file must not
+    re-apply the records its standby already replayed — the seeded
+    state is authoritative and the file is compacted under it (the
+    double-apply would double every n_starts and duplicate the epoch
+    history)."""
+    path = str(tmp_path / "job.journal")
+    tracker = _mk_primary(journal=path, ha_tick_sec=0.05)
+    results = {}
+
+    def boot(tid):
+        results[tid] = P.tracker_rpc(
+            tracker.host, tracker.port, P.CMD_START, tid,
+            listen_port=42000 + int(tid), timeout=5.0, reply_timeout=10.0)
+
+    threads = [threading.Thread(target=boot, args=(t,), daemon=True)
+               for t in ("0", "1")]
+    standby = Standby(journal_path=path, takeover_sec=0.6,
+                      poll_sec=0.05).start()
+    try:
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(10.0)
+        assert tracker.journal.flush(5.0)
+        assert standby.wait_synced(5.0)
+        tracker.kill()
+        assert standby.wait_promoted(8.0)
+        promoted = standby.tracker
+        snap = promoted.journal.state_snapshot()
+        assert snap["n_starts"] == {"0": 1, "1": 1}  # not doubled
+        assert snap["epochs"] == [[0, 2]]            # not duplicated
+        assert snap == standby.state.snapshot()
+    finally:
+        standby.stop()
+
+
+def test_journalless_tracker_refuses_standby():
+    """No journal => the CMD_JOURNAL channel is refused (no ACK): a
+    misconfigured standby must never 'sync' an empty state."""
+    tracker = Tracker(1, quiet=True).start()  # journal=None
+    try:
+        with socket.create_connection((tracker.host, tracker.port),
+                                      timeout=2.0) as sock:
+            P.send_hello(sock, P.CMD_JOURNAL, "sb")
+            sock.settimeout(2.0)
+            with pytest.raises((ConnectionError, socket.timeout)):
+                P.get_u32(sock)
+    finally:
+        tracker.stop()
+
+
+# -- e2e: survivable tracker death -------------------------------------------
+
+def _hist_job(world, niter, sleep_s=0.05):
+    rows, bins = 8 * world, 8
+    data = np.arange(rows) % bins
+
+    def contribution(v, w, r):
+        time.sleep(sleep_s)
+        shard = data[shard_slice(rows, w, r)]
+        return np.bincount(shard, minlength=bins).astype(np.int64) * v
+
+    expected = sum(np.bincount(data, minlength=bins).astype(np.int64) * v
+                   for v in range(1, niter + 1))
+    return contribution, expected
+
+
+def test_failover_mid_wave_e2e():
+    """THE acceptance shape (ISSUE 10): the primary dies while a
+    bootstrap wave is parked on it; the wave re-completes on the
+    promoted standby and the job's collectives are bitwise identical
+    to an undisturbed run."""
+    world, niter = 3, 4
+    contribution, expected = _hist_job(world, niter)
+    tracker = Tracker(world, quiet=True, journal=Journal(None)).start()
+    standby = Standby(primary=(tracker.host, tracker.port),
+                      takeover_sec=0.5, poll_sec=0.05).start()
+    addrs = [(tracker.host, tracker.port), (standby.host, standby.port)]
+    results = {}
+
+    def run(w):
+        results[w.task_id] = w.run()
+
+    workers = [ElasticWorker(addrs, str(i), contribution, niter,
+                             heartbeat_sec=0.2, wave_timeout=10.0,
+                             link_timeout=2.0, deadline_sec=45.0)
+               for i in range(world)]
+    threads = [threading.Thread(target=run, args=(w,), daemon=True)
+               for w in workers]
+    try:
+        for th in threads[:2]:
+            th.start()
+        time.sleep(0.3)  # workers 0 and 1 are parked mid-wave
+        tracker.kill()
+        threads[2].start()  # the wave can only complete on the standby
+        for th in threads:
+            th.join(timeout=60.0)
+            assert not th.is_alive(), "worker hung across the failover"
+    finally:
+        standby.stop()
+        tracker.stop()
+    for tid, res in sorted(results.items()):
+        assert res.completed, (tid, res.error)
+        assert np.array_equal(res.state, expected)
+    promoted = standby.tracker
+    assert promoted is not None
+    kinds = [e["kind"] for e in promoted.events]
+    assert kinds.count("tracker_failover") == 1
+    assert kinds.count("wave") >= 1  # the interrupted wave re-completed
+    # live ranks must not be falsely suspected across the cut
+    assert not [e for e in promoted.events if e["kind"] == "lease_expired"]
+
+
+def test_failover_mid_run_links_survive():
+    """A tracker death with the data plane up: workers keep folding on
+    their established ring (no re-wave needed), heartbeats fail over,
+    and the shutdown handshake lands on the promoted standby."""
+    world, niter = 3, 10
+    contribution, expected = _hist_job(world, niter, sleep_s=0.15)
+    tracker = Tracker(world, quiet=True, journal=Journal(None)).start()
+    standby = Standby(primary=(tracker.host, tracker.port),
+                      takeover_sec=0.4, poll_sec=0.05).start()
+    addrs = [(tracker.host, tracker.port), (standby.host, standby.port)]
+    results = {}
+
+    def run(w):
+        results[w.task_id] = w.run()
+
+    workers = [ElasticWorker(addrs, str(i), contribution, niter,
+                             heartbeat_sec=0.2, wave_timeout=10.0,
+                             link_timeout=2.0, deadline_sec=45.0)
+               for i in range(world)]
+    threads = [threading.Thread(target=run, args=(w,), daemon=True)
+               for w in workers]
+    try:
+        for th in threads:
+            th.start()
+        time.sleep(0.5)  # mid-iteration, wave long closed
+        tracker.kill()
+        for th in threads:
+            th.join(timeout=60.0)
+            assert not th.is_alive()
+    finally:
+        standby.stop()
+        tracker.stop()
+    for res in results.values():
+        assert res.completed and np.array_equal(res.state, expected)
+    promoted = standby.tracker
+    assert promoted is not None
+    # every rank's clean shutdown reached the NEW primary.  Shutdown
+    # bookkeeping is deliberately POST-ACK (the worker exits on the ACK,
+    # the tracker notes it just after), so give the serve thread a beat.
+    deadline = time.monotonic() + 3.0
+    while (promoted._shutdown_tasks != {"0", "1", "2"}
+           and time.monotonic() < deadline):
+        time.sleep(0.02)
+    assert promoted._shutdown_tasks == {"0", "1", "2"}
+    assert not [e for e in promoted.events if e["kind"] == "lease_expired"]
+
+
+def test_standby_death_leaves_job_unbothered():
+    res = run_elastic_schedule(9101, world=3, niter=4,
+                               failover=FaultSpec(standby_death=0.2),
+                               deadline_sec=30.0)
+    assert res.outcome == "completed"
+    assert res.n_failover == 0 and not res.primary_killed
+
+
+def test_localcluster_standby_survives_tracker_kill():
+    """Process-level acceptance: LocalCluster(standby=True) +
+    kill_tracker_after — every worker exits 0 (each self-verifies its
+    final bits), the failover event lands, no live rank is suspected."""
+    import sys
+
+    from rabit_tpu.tracker.launcher import LocalCluster, cpu_worker_env
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    cluster = LocalCluster(3, max_restarts=2, quiet=True, standby=True,
+                           takeover_sec=0.6,
+                           extra_env=cpu_worker_env())
+    cmd = [sys.executable,
+           os.path.join(repo, "tests", "workers", "elastic_worker.py"),
+           "niter=8", "sleep=0.25", "hb=0.2", "deadline=90"]
+    rc = cluster.run(cmd, timeout=120, kill_tracker_after=1.2)
+    assert rc == 0
+    assert all(code == 0 for code in cluster.returncodes.values()), \
+        cluster.returncodes
+    kinds = [e["kind"] for e in cluster.events]
+    assert kinds.count("tracker_failover") == 1
+    assert kinds.count("standby_synced") >= 1
+    assert not [e for e in cluster.events if e["kind"] == "lease_expired"]
+
+
+# -- relays across a failover -------------------------------------------------
+
+def test_relay_rotates_and_replays_across_failover():
+    """Children behind a relay never re-dial: the relay's channel
+    rotates to the promoted root and replays its un-ACKed envelope.
+    The scenario FORCES the takeover to be load-bearing (a worker dies
+    after the cut, so the shrink wave can only close on the standby) —
+    takeover measured, the death detected by the standby's re-armed
+    lease, and the survivors' post-failover work bitwise-verified
+    inside the helper."""
+    from tools.recovery_bench import _failover_once
+
+    rec = _failover_once(3, relays=1, niter=8, iter_sleep=0.12,
+                         kill_at=0.5, takeover_sec=0.4)
+    assert rec["takeover_latency_s"] is not None
+    assert rec["first_wave_after_s"] is not None
+    assert rec["n_lease_expired"] == 1  # the scheduled death, no more
+
+
+def test_quorum_reports_ride_relay_batches():
+    """The PR 9 follow-on: CMD_QUORUM through a relay is an envelope
+    fold + a routed record, not a per-rank root connection — the root's
+    accept count stays O(relays) while the rounds still decide."""
+    world, niter = 2, 4
+    contribution, expected = _hist_job(world, niter)
+    from rabit_tpu.relay import Relay
+
+    tracker = Tracker(world, quiet=True, quorum="1.0").start()
+    relay = Relay((tracker.host, tracker.port), relay_id="rq",
+                  flush_sec=0.05, quiet=True).start()
+    results = {}
+
+    def run(w):
+        results[w.task_id] = w.run()
+
+    workers = [ElasticWorker((relay.host, relay.port), str(i),
+                             contribution, niter, heartbeat_sec=0.0,
+                             wave_timeout=10.0, link_timeout=2.0,
+                             deadline_sec=40.0, quorum="1.0",
+                             quorum_wait=0.2)
+               for i in range(world)]
+    threads = [threading.Thread(target=run, args=(w,), daemon=True)
+               for w in workers]
+    try:
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=50.0)
+            assert not th.is_alive()
+    finally:
+        relay.stop()
+        tracker.stop()
+    for res in results.values():
+        assert res.completed and np.array_equal(res.state, expected)
+        assert res.quorum_rounds == niter
+    # quorum=1.0 decided every round THROUGH the envelope: the root
+    # accepted only the relay channel plus rank-0's proxied per-commit
+    # blob uploads — never a per-rank quorum connection storm (which
+    # would be >= world x niter accepts on its own)
+    assert tracker.serve_stats["batch_msgs"] >= world * niter
+    assert tracker.serve_stats["accepts"] <= 2 + niter
+
+
+# -- chaos campaign + bench gate ---------------------------------------------
+
+#: (seed, kwargs) — the primary killed mid-bootstrap, mid-run,
+#: mid-quorum-round, and mid-shrink-wave (a worker dies and the shrink
+#: deadline forces a recovery wave around the failover instant).
+_FAILOVER_SCENARIOS = [
+    (9301, dict(world=3, niter=5, iter_sleep=0.1,
+                failover=FaultSpec(tracker_death=0.05))),   # mid-bootstrap
+    (9302, dict(world=3, niter=6, iter_sleep=0.15,
+                failover=FaultSpec(tracker_death=0.5))),    # mid-run
+    (9303, dict(world=3, niter=5, quorum="0.67", straggler=(1, 0.5),
+                quorum_wait=0.15, deadline_sec=45.0,
+                failover=FaultSpec(tracker_death=0.8))),    # mid-quorum
+    (9304, dict(world=3, niter=8, iter_sleep=0.15, deadline_sec=45.0,
+                failover=FaultSpec(tracker_death=0.6))),    # mid-shrink
+    (9305, dict(world=4, niter=6, iter_sleep=0.12, relays=1,
+                deadline_sec=45.0,
+                failover=FaultSpec(tracker_death=0.4))),    # behind relays
+]
+
+
+@pytest.mark.parametrize("seed,kw", _FAILOVER_SCENARIOS)
+def test_chaos_failover_campaign(seed, kw):
+    """Heal-then-must-converge with the tracker itself as the casualty:
+    whatever phase the kill lands in, the job completes with the exact
+    closed-form bits (the harness asserts bitwise identity and the
+    quorum-adjusted closed form internally) and no live rank is
+    suspected."""
+    res = run_elastic_schedule(seed, **kw)
+    assert res.outcome == "completed"
+    assert res.n_spurious_expired == 0
+    assert res.n_journal_gap == 0
+    if res.primary_killed:
+        assert res.n_failover <= 1
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", range(9400, 9420))
+def test_chaos_failover_campaign_slow(seed):
+    """The wide sweep: seeded kill times x sampled schedules/faults —
+    every schedule must converge bitwise through the failover."""
+    rng = random.Random(seed)
+    kw = dict(world=rng.choice([2, 3, 4]), niter=rng.choice([5, 6, 8]),
+              iter_sleep=rng.choice([0.08, 0.12, 0.15]),
+              relays=rng.choice([0, 0, 1]),
+              deadline_sec=50.0,
+              failover=FaultSpec(
+                  tracker_death=rng.choice([0.05, 0.3, 0.6, 1.0])))
+    if rng.random() < 0.3:
+        kw.update(quorum="0.67", straggler=(1, 0.4), quorum_wait=0.15)
+    res = run_elastic_schedule(seed, **kw)
+    assert res.outcome == "completed"
+    assert res.n_spurious_expired == 0
+    assert res.n_journal_gap == 0
+
+
+def test_failover_bench_smoke():
+    """The recovery_bench --failover gate: a takeover latency within
+    the lease and a post-failover recovery wave, from structured
+    events — plus the standby expiring the scheduled death's re-armed
+    lease (exactly one lease_expired)."""
+    from tools.recovery_bench import _failover_once
+
+    rec = _failover_once(2, relays=0, niter=8, iter_sleep=0.12,
+                         kill_at=0.5, takeover_sec=0.4)
+    assert rec["takeover_latency_s"] is not None
+    assert rec["takeover_latency_s"] < 3.0
+    assert rec["first_wave_after_s"] is not None
+    assert rec["n_lease_expired"] == 1

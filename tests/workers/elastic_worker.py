@@ -34,8 +34,10 @@ import numpy as np
 
 sys.path.insert(0, __file__.rsplit("/tests/", 1)[0])
 
+from rabit_tpu.config import Config  # noqa: E402
 from rabit_tpu.elastic.client import ElasticWorker  # noqa: E402
 from rabit_tpu.elastic.rebalance import shard_slice  # noqa: E402
+from rabit_tpu.tracker.protocol import parse_addrs  # noqa: E402
 
 
 def getarg(name: str, default: str) -> str:
@@ -70,7 +72,13 @@ def main() -> int:
         shard = data[shard_slice(rows, world, rank)]
         return np.bincount(shard, minlength=bins).astype(np.int64) * version
 
-    worker = ElasticWorker((host, port), task_id, contribution, niter,
+    # The HA failover list (doc/ha.md): the launcher exports
+    # rabit_tracker_addrs (primary first, then the warm standby) via the
+    # config env layer; the worker rotates through it on failure.
+    addrs = parse_addrs(
+        Config(sys.argv[1:]).get("rabit_tracker_addrs", "") or "")
+    tracker = addrs if addrs else (host, port)
+    worker = ElasticWorker(tracker, task_id, contribution, niter,
                            spare=spare, heartbeat_sec=hb,
                            deadline_sec=deadline, fail=fail)
     res = worker.run()

@@ -26,6 +26,15 @@ heartbeat load at worlds 512-8192, thread-per-connection vs reactor vs
 relayed — the recovery half of the RESULTS §3e curve (bootstrap rides
 along; ``tools/consensus_bench.py --scale-sweep`` is the same sweep).
 
+``--failover`` switches to the HA-failover mode (doc/ha.md): per world
+size, an in-thread elastic job with a warm standby gets its PRIMARY
+TRACKER killed abruptly mid-run (``Tracker.kill()``, the in-process
+SIGKILL), with and without a relay tier in front.  Rows report the
+takeover latency (kill -> ``tracker_failover``) and the recovery
+latency (kill -> the first wave/commit progress after the takeover),
+all from structured events.  The driver embeds these lines under
+``"ha_failover"`` in the bench record (``RABIT_BENCH_HA=0`` skips).
+
 ``--blob-mb B [B ...]`` switches to the checkpoint-serve-scaling mode
 (round-5 verdict #3): the worker carries a B-MiB content-verified blob in
 its global model, so the restarted rank's recovery streams a realistic
@@ -320,6 +329,138 @@ def _elastic_once(world: int, *, with_spare: bool, grow_back: bool,
     }
 
 
+def _failover_once(world: int, *, relays: int, kill_at: float = 0.8,
+                   niter: int = 10, iter_sleep: float = 0.12,
+                   takeover_sec: float = 0.5,
+                   deadline_sec: float = 60.0) -> dict:
+    """One HA failover scenario (doc/ha.md): an in-thread elastic job
+    with a warm standby, the primary killed abruptly at ``kill_at``.
+    Latencies come from structured events: takeover = kill ->
+    ``tracker_failover`` ts, recovery = kill -> the first post-failover
+    progress (a wave closed on the standby, and the first worker commit
+    after the cut).  The last rank dies a few versions AFTER the
+    tracker kill, so the survivors MUST re-wave on the promoted standby
+    (shrink) — the takeover is load-bearing, not incidental: a bench
+    run that completes proves the failover carried a recovery wave."""
+    import threading
+
+    import numpy as np
+
+    from rabit_tpu.elastic.client import ElasticWorker
+    from rabit_tpu.elastic.rebalance import shard_slice
+    from rabit_tpu.ha import Journal, Standby
+    from rabit_tpu.relay import Relay
+    from rabit_tpu.tracker.tracker import Tracker
+
+    n_rows, n_bins = 8 * world, 8
+    data = np.arange(n_rows) % n_bins
+
+    def contribution(version, w, r):
+        time.sleep(iter_sleep)
+        rows = data[shard_slice(n_rows, w, r)]
+        return np.bincount(rows, minlength=n_bins).astype(np.int64) * version
+
+    expected = sum(np.bincount(data, minlength=n_bins).astype(np.int64) * v
+                   for v in range(1, niter + 1))
+    die_at = max(2, int(round(kill_at / iter_sleep)) + 2)  # post-failover
+    tracker_kwargs = dict(quiet=True, promote_after_sec=0.05,
+                          shrink_after_sec=0.8)
+    tracker = Tracker(world, journal=Journal(None),
+                      **tracker_kwargs).start()
+    addr = (tracker.host, tracker.port)
+    standby = Standby(primary=addr, takeover_sec=takeover_sec,
+                      poll_sec=0.05,
+                      tracker_kwargs=tracker_kwargs).start()
+    addrs = [addr, (standby.host, standby.port)]
+    relay_objs = [Relay(addrs, relay_id=f"relay{i}", flush_sec=0.1,
+                        quiet=True).start() for i in range(relays)]
+
+    def worker_target(i: int):
+        if not relay_objs:
+            return addrs
+        r = relay_objs[i % len(relay_objs)]
+        return (r.host, r.port)
+
+    results = {}
+
+    def run_worker(w):
+        results[w.task_id] = w.run()
+
+    workers = [ElasticWorker(worker_target(i), str(i), contribution, niter,
+                             heartbeat_sec=0.15, wave_timeout=15.0,
+                             link_timeout=2.0, deadline_sec=deadline_sec,
+                             fail=(("die", die_at) if i == world - 1
+                                   else None))
+               for i in range(world)]
+    threads = [threading.Thread(target=run_worker, args=(w,), daemon=True)
+               for w in workers]
+    t_kill = None
+    try:
+        for th in threads:
+            th.start()
+        time.sleep(kill_at)
+        t_kill = time.time()
+        t_kill_mono = time.monotonic()
+        tracker.kill()
+        for th in threads:
+            th.join(timeout=deadline_sec + 10.0)
+            if th.is_alive():
+                raise TimeoutError(f"failover bench world={world}: hang")
+    finally:
+        standby.stop()
+        tracker.stop()
+        for r in relay_objs:
+            r.stop()
+    for res in results.values():
+        if res.died:
+            continue  # the scheduled post-failover death
+        if not res.completed or not np.array_equal(res.state, expected):
+            raise RuntimeError(f"failover bench world={world}: worker "
+                               f"{res.task_id} wrong/incomplete "
+                               f"({res.error!r})")
+    promoted = standby.tracker
+    events = list(tracker.events) + (list(promoted.events)
+                                     if promoted is not None else [])
+    t_failover = next((e["ts"] for e in events
+                       if e["kind"] == "tracker_failover"), None)
+    post_waves = [e["ts"] for e in events
+                  if e["kind"] == "wave" and e["ts"] > (t_failover or 1e18)]
+    # first commit strictly after the kill (monotonic clock, same basis
+    # as the workers' commit_times)
+    post_commits = [ts for res in results.values()
+                    for ts in res.commit_times.values()
+                    if ts > t_kill_mono]
+    rec = {
+        "mode": "ha_failover", "world": world, "relays": relays,
+        "kill_at_s": kill_at, "takeover_sec": takeover_sec,
+        "takeover_latency_s": (round(t_failover - t_kill, 3)
+                               if t_failover is not None else None),
+        "first_wave_after_s": (round(min(post_waves) - t_kill, 3)
+                               if post_waves else None),
+        "first_commit_after_s": (round(min(post_commits) - t_kill_mono, 3)
+                                 if post_commits else None),
+        # exactly ONE expected: the scheduled post-failover death's
+        # lease, expired BY THE STANDBY (proof the re-armed lease table
+        # still detects failures after the cut); more would be live
+        # ranks suspected spuriously
+        "n_lease_expired": sum(
+            1 for e in events if e["kind"] == "lease_expired"),
+    }
+    return rec
+
+
+def failover_sweep(worlds: list[int]) -> list[dict]:
+    """The --failover mode: kill-the-primary latency rows, direct and
+    through a relay tier, per world size."""
+    out = []
+    for world in worlds:
+        for relays in (0, 1):
+            rec = _failover_once(world, relays=relays)
+            out.append(rec)
+            print(json.dumps(rec), flush=True)
+    return out
+
+
 def elastic_sweep(worlds: list[int],
                   shrink_after_sec: float = 1.0) -> list[dict]:
     """The promotion-vs-shrink curve: per world size, the same induced
@@ -361,6 +502,11 @@ def main() -> None:
                     help="elastic-membership mode: spare-promotion vs "
                          "shrink-wave latency per world size "
                          "(doc/elasticity.md)")
+    ap.add_argument("--failover", action="store_true",
+                    help="HA failover mode: primary-tracker kill -> "
+                         "standby takeover / first post-failover "
+                         "progress latency, with and without relays "
+                         "(doc/ha.md)")
     ap.add_argument("--shrink-after", type=float, default=1.0,
                     help="elastic mode's rabit_shrink_after_sec")
     ap.add_argument("--scale-sweep", action="store_true",
@@ -372,6 +518,8 @@ def main() -> None:
         from tools.scale_sweep import scale_sweep
 
         scale_sweep(args.worlds or [512, 1024, 2048, 4096])
+    elif args.failover:
+        failover_sweep(args.worlds or [2, 4])
     elif args.elastic:
         elastic_sweep(args.worlds or [2, 4], args.shrink_after)
     elif args.resume:
