@@ -26,6 +26,7 @@ import json
 import os
 import subprocess
 import sys
+import threading
 import time
 
 import numpy as np
@@ -110,6 +111,17 @@ SCALE_WORLDS = os.environ.get("RABIT_BENCH_SCALE_WORLDS", "512 1024")
 # skips it.
 HA_BENCH = os.environ.get("RABIT_BENCH_HA", "1") != "0"
 HA_CHILD_TIMEOUT = 180.0
+# Fused-vs-host A/B (ISSUE 11): the in-XLA fused encode->ppermute->
+# decode-fold graph (rabit_tpu/engine/fused.py) against the numpy host
+# transport, per codec, on a virtual CPU mesh in a child — the "does the
+# fusion pay for itself off-TPU" arm (gate: fused no slower than host at
+# >=1 MiB payloads).  Deducted from the TPU budget like the other riders;
+# RABIT_BENCH_FUSED=0 skips it.
+FUSED_BENCH = os.environ.get("RABIT_BENCH_FUSED", "1") != "0"
+FUSED_CHILD_TIMEOUT = 180.0
+FUSED_WORLD = 4
+FUSED_ELEMS = 1 << 18  # 1 MiB of f32 — the acceptance bar's payload floor
+FUSED_CODECS = ("i8", "bf16x2")
 
 
 def log(msg):
@@ -212,7 +224,7 @@ def device_worker(n_rows, n_rounds, force_cpu):
         xb3 = jnp.asarray(xb)
     y_d = jnp.asarray(y)
 
-    def time_mode(cfg):
+    def time_mode(cfg, mxu_label):
         if fused:
             step = jax.jit(functools.partial(gbdt.train_round_fused, cfg=cfg),
                            donate_argnums=0)
@@ -227,13 +239,23 @@ def device_worker(n_rows, n_rounds, force_cpu):
         # platform; a host readback of a small output does.
         jax.device_get(state.forest.leaf)
         log(f"worker: compiled; timing {n_rounds} rounds")
+        # Partial-round capture (ISSUE 11): emit a best-so-far line after
+        # EVERY timed round (fenced, so the time is real), marked
+        # "partial": k.  A backend that wedges mid-run then still leaves a
+        # salvageable on-chip measurement in the parent's stdout sweep —
+        # BENCH_r03-r05 recorded forced-CPU lines while the chip had
+        # already produced timeable rounds.  run_child prefers final
+        # (unmarked) lines, so partials never shadow a completed race.
         t0 = time.perf_counter()
-        for _ in range(n_rounds):
+        for k in range(1, n_rounds + 1):
             state = step(state, xb3, y_d)
-        jax.device_get(state.forest.leaf)
+            jax.device_get(state.forest.leaf)
+            print(json.dumps({"device_time": (time.perf_counter() - t0) / k,
+                              "platform": plat, "mxu": mxu_label,
+                              "partial": k}), flush=True)
         return (time.perf_counter() - t0) / n_rounds
 
-    dt = time_mode(base_cfg)
+    dt = time_mode(base_cfg, "bf16" if fused else "n/a")
     # Emit the bf16 result IMMEDIATELY: the parent takes the last parseable
     # stdout line, so if the i8 attempt below hangs the backend (the axon
     # failure mode is hang-not-raise) and the child is killed at the
@@ -247,7 +269,7 @@ def device_worker(n_rows, n_rounds, force_cpu):
         # Guarded: a failure in the newer path must not cost the bench line.
         dt_i8 = float("inf")
         try:
-            dt_i8 = time_mode(base_cfg._replace(mxu_i8=True))
+            dt_i8 = time_mode(base_cfg._replace(mxu_i8=True), "i8")
             log(f"worker: bf16 {dt * 1e3:.1f} ms vs i8 {dt_i8 * 1e3:.1f} ms")
             if dt_i8 < dt:
                 print(json.dumps({"device_time": dt_i8, "platform": plat,
@@ -265,7 +287,8 @@ def device_worker(n_rows, n_rounds, force_cpu):
         try:
             best = base_cfg._replace(mxu_i8=True) if dt_i8 < dt else base_cfg
             dt_best = min(dt, dt_i8)
-            dt_ff = time_mode(best._replace(fused_final=True))
+            dt_ff = time_mode(best._replace(fused_final=True),
+                              "i8" if best.mxu_i8 else "bf16")
             log(f"worker: xla-final {dt_best * 1e3:.1f} ms vs "
                 f"fused-final {dt_ff * 1e3:.1f} ms")
             if dt_ff < dt_best:
@@ -541,6 +564,137 @@ def probe_device(timeout=45.0) -> bool:
     return ok
 
 
+#: Stale libtpu lock files a killed-at-timeout child can leave behind —
+#: the one wedge artifact a driver-side reset can actually clear.
+_TPU_LOCKFILES = ("/tmp/libtpu_lockfile",)
+
+
+class ProbeDaemon:
+    """Persistent device prober (ISSUE 11): the one-shot :func:`probe_device`
+    promoted to a background thread with a backend reset/retry budget.
+
+    The daemon probes on a cadence whenever it is not paused (full bench
+    children pause it — the chip is single-tenant, probes and children
+    must never overlap), keeps a rolling verdict, and after
+    ``reset_after`` consecutive failures spends one unit of the reset
+    budget clearing the stale libtpu lock files a timeout-killed child
+    can leave behind, then probes again immediately.  ``snapshot()`` is
+    the probe evidence the driver record embeds: even a run that never
+    reaches the chip now documents *why* (attempts, failures, resets,
+    last error age) instead of recording an empty TPU round."""
+
+    def __init__(self, interval=45.0, probe_timeout=45.0, reset_budget=2,
+                 reset_after=2):
+        self.interval = interval
+        self.probe_timeout = probe_timeout
+        self.reset_budget = reset_budget
+        self.reset_after = reset_after
+        self.attempts = 0
+        self.successes = 0
+        self.resets = 0
+        self.consecutive_failures = 0
+        self.last_ok_at: float | None = None
+        self._stop = threading.Event()
+        self._resume = threading.Event()
+        self._resume.set()
+        self._lock = threading.Lock()
+        # serializes actual probe children: the cadence loop and a caller's
+        # synchronous probe_now() must not hit the chip concurrently
+        self._probe_mutex = threading.Lock()
+        self._thread: threading.Thread | None = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._loop,
+                                        name="bench-probe", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        self._resume.set()
+
+    def pause(self):
+        """Suspend probing (a full child is about to own the chip)."""
+        self._resume.clear()
+
+    def resume(self):
+        self._resume.set()
+
+    def probe_now(self) -> bool:
+        """One synchronous probe (also used by the loop), with the reset
+        escalation applied on repeated failure."""
+        with self._probe_mutex:
+            return self._probe_locked()
+
+    def _probe_locked(self) -> bool:
+        ok = probe_device(timeout=self.probe_timeout)
+        with self._lock:
+            self.attempts += 1
+            if ok:
+                self.successes += 1
+                self.consecutive_failures = 0
+                self.last_ok_at = time.time()
+                return True
+            self.consecutive_failures += 1
+            do_reset = (self.consecutive_failures >= self.reset_after
+                        and self.resets < self.reset_budget)
+            if do_reset:
+                self.resets += 1
+        if do_reset:
+            self._reset_backend()
+            ok = probe_device(timeout=self.probe_timeout)
+            with self._lock:
+                self.attempts += 1
+                if ok:
+                    self.successes += 1
+                    self.consecutive_failures = 0
+                    self.last_ok_at = time.time()
+        return ok
+
+    def _reset_backend(self):
+        cleared = []
+        for path in _TPU_LOCKFILES:
+            try:
+                os.unlink(path)
+                cleared.append(path)
+            except OSError:
+                pass
+        log(f"probe daemon: backend reset {self.resets}/{self.reset_budget}"
+            + (f" (cleared {', '.join(cleared)})" if cleared
+               else " (no stale lock files found)"))
+
+    def healthy(self, max_age=None) -> bool:
+        """A probe succeeded within ``max_age`` seconds (default: two
+        probe intervals) — recent enough evidence to spend a full child
+        attempt on the chip."""
+        with self._lock:
+            last = self.last_ok_at
+        if last is None:
+            return False
+        return time.time() - last <= (max_age if max_age is not None
+                                      else 2 * self.interval)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "attempts": self.attempts,
+                "successes": self.successes,
+                "resets": self.resets,
+                "reset_budget": self.reset_budget,
+                "consecutive_failures": self.consecutive_failures,
+                "last_ok_age_s": (round(time.time() - self.last_ok_at, 1)
+                                  if self.last_ok_at is not None else None),
+            }
+
+    def _loop(self):
+        while not self._stop.is_set():
+            if self._resume.is_set():
+                self.probe_now()
+            # wait() returns early when resume() fires mid-pause; the stop
+            # event ends the daemon regardless of pause state
+            self._stop.wait(self.interval)
+
+
 def run_child(n_rows, n_rounds, force_cpu, timeout):
     cmd = [sys.executable, os.path.abspath(__file__), "--device-worker",
            str(n_rows), str(n_rounds), str(int(force_cpu))]
@@ -555,54 +709,89 @@ def run_child(n_rows, n_rounds, force_cpu, timeout):
         for line in _text(te.stderr).splitlines():
             print(line, file=sys.stderr, flush=True)
         log(f"child timed out after {timeout:.0f}s (force_cpu={force_cpu})")
-        # Salvage a result the child printed before hanging (e.g. the bf16
-        # line emitted before a wedged i8 compile attempt).
-        for line in reversed(_text(te.stdout).strip().splitlines()):
-            try:
-                res = json.loads(line)
-                log("salvaged pre-hang result from child stdout")
-                return res
-            except json.JSONDecodeError:
-                continue
+        # Salvage a result the child printed before hanging: the last
+        # completed-race line if one landed, else the last PARTIAL-round
+        # capture (the per-round best-so-far lines time_mode emits) — a
+        # wedge mid-run still yields an on-chip measurement instead of the
+        # forced-CPU fallback erasing it (BENCH_r03-r05 failure mode).
+        res = _pick_result(_text(te.stdout))
+        if res is not None:
+            log("salvaged pre-hang result from child stdout"
+                + (f" (partial, {res['partial']} round(s))"
+                   if "partial" in res else ""))
+            return res
         return "timeout"
     for line in r.stderr.splitlines():
         print(line, file=sys.stderr, flush=True)
     if r.returncode != 0:
         tail = (r.stderr or "").strip().splitlines()[-3:]
         log(f"child rc={r.returncode}: {' | '.join(tail)}")
-        return None
-    for line in reversed(r.stdout.strip().splitlines()):
+        # a crash after timed rounds still salvages the partial capture
+        res = _pick_result(r.stdout or "")
+        return res
+    res = _pick_result(r.stdout or "")
+    if res is None:
+        log("child produced no JSON")
+    return res
+
+
+def _pick_result(stdout: str):
+    """The child's verdict from its stdout stream: the LAST final
+    (unmarked) measurement line wins; with only partial-round captures on
+    the stream, the last partial wins (its ``"partial"`` key survives into
+    the driver record as evidence).  Partial lines from a losing
+    challenger race can never shadow an earlier completed race."""
+    final = partial = None
+    for line in stdout.strip().splitlines():
         try:
-            return json.loads(line)
+            rec = json.loads(line)
         except json.JSONDecodeError:
             continue
-    log("child produced no JSON")
-    return None
+        if not isinstance(rec, dict) or "device_time" not in rec:
+            continue
+        if "partial" in rec:
+            partial = rec
+        else:
+            final = rec
+    return final if final is not None else partial
 
 
-def try_tpu_within_budget(budget=None):
+def try_tpu_within_budget(budget=None, daemon=None):
     """Spend the full TPU wall budget attempting the chip.
 
     Returns the child's result dict, or None if the budget expired without
     a measurement.  Sequence: immediate first attempt (capped — a child
     wedged at backend init salvages nothing, so it must not consume the
-    whole budget), then 45s-cadence probes gating further full attempts
-    (a probe success means the tunnel healed; children and probes never
-    overlap, the chip is single-tenant), then one blind last-ditch attempt
-    with whatever remains — the child prints its bf16 measurement the
-    moment it has one, so even a truncated attempt can salvage a number.
+    whole budget), then the persistent :class:`ProbeDaemon`'s rolling
+    verdict gates further full attempts: a recent probe success means the
+    tunnel healed, repeated failures spend the daemon's reset budget on
+    clearing stale lock files.  The daemon is PAUSED around every full
+    child (the chip is single-tenant; probes and children never overlap).
+    Ends with one blind last-ditch attempt with whatever remains — the
+    child prints a partial-round line after every timed round, so even a
+    truncated attempt salvages an on-chip number.
     """
     # Anchor at ENTRY, not process start: the ~2s numpy baseline measured
     # before this must not be charged against the chip's budget.
     deadline = time.time() + (TPU_WALL_BUDGET if budget is None else budget)
     remaining = lambda: deadline - time.time()
+
+    def attempt_child(t):
+        if daemon is not None:
+            daemon.pause()
+        try:
+            return run_child(N_ROWS, TPU_ROUNDS, force_cpu=False, timeout=t)
+        finally:
+            if daemon is not None:
+                daemon.resume()
+
     attempt = 0
     while remaining() > 30:
         attempt += 1
         if attempt == 1:
             t = min(TPU_CHILD_TIMEOUT, FIRST_ATTEMPT_CAP, remaining())
             log(f"TPU attempt 1 (timeout {t:.0f}s of {remaining():.0f}s budget)")
-            res = run_child(N_ROWS, TPU_ROUNDS, force_cpu=False, timeout=t)
+            res = attempt_child(t)
             if isinstance(res, dict):
                 return res
             continue
@@ -611,17 +800,143 @@ def try_tpu_within_budget(budget=None):
             # rest.  A healthy backend gets the bf16 number out in ~90s.
             t = remaining()
             log(f"last-ditch blind TPU attempt ({t:.0f}s left)")
-            res = run_child(N_ROWS, TPU_ROUNDS, force_cpu=False, timeout=t)
+            res = attempt_child(t)
             return res if isinstance(res, dict) else None
-        if probe_device(timeout=min(45.0, remaining())):
+        healed = (daemon.healthy() or daemon.probe_now()) if daemon is not None \
+            else probe_device(timeout=min(45.0, remaining()))
+        if healed:
             t = min(TPU_CHILD_TIMEOUT, remaining())
             log(f"probe OK; TPU attempt {attempt} (timeout {t:.0f}s)")
-            res = run_child(N_ROWS, TPU_ROUNDS, force_cpu=False, timeout=t)
+            res = attempt_child(t)
             if isinstance(res, dict):
                 return res
         else:
             time.sleep(min(10, max(0, remaining() - 150)))
     return None
+
+
+def fused_worker(world, n_elems, n_iters):
+    """Child (forced CPU, virtual ``world``-device mesh): time the fused
+    in-XLA allreduce graph against the numpy host transport per codec and
+    print one JSON line per codec.  The host arm measures ONE rank's real
+    compute cost (encode + W decodes + rank-order fold) over a loopback
+    engine; the fused arm runs the whole jitted graph (all W ranks' work,
+    parallelized over the device threads).  Each line also carries the
+    bitwise-parity verdict against the closed-form reference fold."""
+    from rabit_tpu._platform import force_cpu_platform
+
+    force_cpu_platform(world)
+
+    from rabit_tpu import compress
+    from rabit_tpu.compress import transport
+    from rabit_tpu.config import Config
+    from rabit_tpu.engine import fused as F
+    from rabit_tpu.engine.base import SUM
+
+    class _Loopback:
+        """Minimal engine stand-in: rank 0 of a W-world where every rank
+        contributed the same bytes — per-rank host-path cost is exact."""
+
+        def get_world_size(self):
+            return world
+
+        def allreduce(self, data, op, prepare_fun=None, cache_key=None):
+            return data
+
+        def allgather(self, data, cache_key=None):
+            return np.tile(np.asarray(data), world)
+
+    rng = np.random.RandomState(11)
+    contribs = [(rng.randn(n_elems) * 20).astype(np.float32)
+                for _ in range(world)]
+    mesh = F.local_mesh(world)
+    order = F.plan_ring_order(world, Config([]))
+    garr = F.place_contributions(mesh, contribs)
+    loop_eng = _Loopback()
+    for codec_name in FUSED_CODECS:
+        codec = compress.get_codec(codec_name)
+        ref = transport.reference_allreduce(contribs, SUM, codec)
+        fn = F.build_fused_allreduce(mesh, order, SUM, codec, n_elems)
+        out = np.asarray(fn(garr))  # compile + warm
+        fused_ok = bool(np.array_equal(out[0], ref))
+        t0 = time.perf_counter()
+        for _ in range(n_iters):
+            np.asarray(fn(garr))
+        fused_s = (time.perf_counter() - t0) / n_iters
+        host = transport.host_allreduce(loop_eng, contribs[0], SUM, codec)
+        host_ok = bool(np.array_equal(
+            host, transport.reference_allreduce([contribs[0]] * world, SUM,
+                                                codec)))
+        t0 = time.perf_counter()
+        for _ in range(n_iters):
+            transport.host_allreduce(loop_eng, contribs[0], SUM, codec)
+        host_s = (time.perf_counter() - t0) / n_iters
+        line = {
+            "bench": "fused_ab",
+            "codec": codec_name,
+            "world": world,
+            "payload_bytes": int(4 * n_elems),
+            "fused_s": round(fused_s, 6),
+            "host_s": round(host_s, 6),
+            "fused_vs_host": round(host_s / fused_s, 3),
+            "fused_bitwise_ok": fused_ok,
+            "host_bitwise_ok": host_ok,
+        }
+        log(f"fused A/B {codec_name}: fused {fused_s * 1e3:.2f} ms vs host "
+            f"{host_s * 1e3:.2f} ms ({line['fused_vs_host']}x), "
+            f"parity={'ok' if fused_ok else 'BROKEN'}")
+        print(json.dumps(line), flush=True)
+
+
+def run_fused_bench(timeout=FUSED_CHILD_TIMEOUT):
+    """Fused-vs-host A/B lines (``--fused-worker``) in a child (it pins a
+    virtual multi-device CPU platform, which must happen in a fresh
+    process).  Returns the record list, empty on timeout/failure — the
+    arm must never cost the main metric its line."""
+    cmd = [sys.executable, os.path.abspath(__file__), "--fused-worker",
+           str(FUSED_WORLD), str(FUSED_ELEMS), "5"]
+    lines = []
+    try:
+        r = subprocess.run(cmd, timeout=timeout, capture_output=True,
+                           text=True)
+        if r.returncode == 0:
+            for line in r.stdout.strip().splitlines():
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if isinstance(rec, dict) and rec.get("bench") == "fused_ab":
+                    lines.append(rec)
+        else:
+            tail = (r.stderr or "").strip().splitlines()[-3:]
+            log(f"fused A/B child rc={r.returncode}: {' | '.join(tail)}")
+    except subprocess.TimeoutExpired:
+        log(f"fused A/B child timed out after {timeout:.0f}s")
+    return lines
+
+
+def codec_pareto(codec_lines):
+    """The allreduce-bytes x rounds/s frontier over the codec-ablation
+    lines: one row per codec, ``on_frontier`` true when no other codec has
+    both fewer wire bytes and at least the throughput (the wire/throughput
+    trade-off as ONE record instead of two disjoint columns)."""
+    rows = []
+    for line in codec_lines:
+        if "allreduce_wire_bytes" not in line or "rounds_per_sec" not in line:
+            continue
+        rows.append({
+            "codec": line.get("codec", "?"),
+            "allreduce_wire_bytes": int(line["allreduce_wire_bytes"]),
+            "rounds_per_sec": float(line["rounds_per_sec"]),
+        })
+    for row in rows:
+        row["on_frontier"] = not any(
+            (o["allreduce_wire_bytes"] <= row["allreduce_wire_bytes"]
+             and o["rounds_per_sec"] >= row["rounds_per_sec"]
+             and (o["allreduce_wire_bytes"] < row["allreduce_wire_bytes"]
+                  or o["rounds_per_sec"] > row["rounds_per_sec"]))
+            for o in rows if o is not row)
+    return rows
 
 
 def parked_tpu_capture():
@@ -709,7 +1024,23 @@ def main():
                          min(tpu_budget, 300.0))
         log(f"ha failover bench: {len(ha_lines)} line(s); "
             f"TPU budget now {tpu_budget:.0f}s")
-    res = try_tpu_within_budget(tpu_budget)
+    fused_lines = []
+    if FUSED_BENCH:
+        t_f = time.time()
+        fused_lines = run_fused_bench()
+        tpu_budget = max(tpu_budget - (time.time() - t_f),
+                         min(tpu_budget, 300.0))
+        log(f"fused A/B bench: {len(fused_lines)} line(s); "
+            f"TPU budget now {tpu_budget:.0f}s")
+    probe_daemon = ProbeDaemon().start()
+    # start paused: attempt 1 launches immediately and owns the chip; the
+    # child's teardown resumes the cadence for the probe-gated retries
+    probe_daemon.pause()
+    try:
+        res = try_tpu_within_budget(tpu_budget, daemon=probe_daemon)
+    finally:
+        probe_daemon.stop()
+    probe_evidence = probe_daemon.snapshot()
     n_rows = N_ROWS
     if not isinstance(res, dict):
         # Forced-CPU fallback: smaller problem so the jitted round fits the
@@ -732,8 +1063,10 @@ def main():
         cap = parked_tpu_capture()
         if cap is not None:
             rec["last_tpu_capture"] = cap
+        rec["device_probe"] = probe_evidence
         if codec_lines:
             rec["codec_ablation"] = codec_lines
+            rec["codec_pareto"] = codec_pareto(codec_lines)
         if elastic_lines:
             rec["elastic"] = elastic_lines
         if sched_lines:
@@ -744,6 +1077,8 @@ def main():
             rec["scale_sweep"] = scale_lines
         if ha_lines:
             rec["ha_failover"] = ha_lines
+        if fused_lines:
+            rec["fused_ab"] = fused_lines
         print(json.dumps(rec), flush=True)
         return
     device_time = res["device_time"]
@@ -779,12 +1114,19 @@ def main():
         # the 2026-07-31 capture) instead carry "final": "xla" for the
         # non-default xla-final win over the then-default fused kernel.
         rec["final"] = res["final"]
+    if "partial" in res:
+        # a wedge cut the run short; the value is the fenced best-so-far
+        # average over this many completed rounds — probe-evidenced
+        # partial capture, not an empty TPU round
+        rec["partial_rounds"] = int(res["partial"])
+    rec["device_probe"] = probe_evidence
     if res["platform"] != "tpu":
         cap = parked_tpu_capture()
         if cap is not None:
             rec["last_tpu_capture"] = cap
     if codec_lines:
         rec["codec_ablation"] = codec_lines
+        rec["codec_pareto"] = codec_pareto(codec_lines)
     if elastic_lines:
         rec["elastic"] = elastic_lines
     if sched_lines:
@@ -795,6 +1137,8 @@ def main():
         rec["scale_sweep"] = scale_lines
     if ha_lines:
         rec["ha_failover"] = ha_lines
+    if fused_lines:
+        rec["fused_ab"] = fused_lines
     print(json.dumps(rec), flush=True)
 
 
@@ -805,8 +1149,22 @@ if __name__ == "__main__":
         codec_worker(int(sys.argv[2]), int(sys.argv[3]))
     elif len(sys.argv) > 1 and sys.argv[1] == "--codec-ablation":
         # Standalone trajectory: one JSON line per codec on stdout (the
-        # same lines main() embeds under "codec_ablation").
-        for rec in run_codec_ablation():
+        # same lines main() embeds under "codec_ablation"), the Pareto
+        # frontier row the driver record carries, and the fused-vs-host
+        # A/B arm (RABIT_BENCH_FUSED=0 skips it here too).
+        lines = run_codec_ablation()
+        for rec in lines:
+            print(json.dumps(rec), flush=True)
+        if lines:
+            print(json.dumps({"codec_pareto": codec_pareto(lines)}),
+                  flush=True)
+        if FUSED_BENCH:
+            for rec in run_fused_bench():
+                print(json.dumps(rec), flush=True)
+    elif len(sys.argv) > 1 and sys.argv[1] == "--fused-worker":
+        fused_worker(int(sys.argv[2]), int(sys.argv[3]), int(sys.argv[4]))
+    elif len(sys.argv) > 1 and sys.argv[1] == "--fused-ab":
+        for rec in run_fused_bench():
             print(json.dumps(rec), flush=True)
     else:
         main()

@@ -132,6 +132,8 @@ def init(args: list[str] | None = None, **overrides: Any) -> None:
         wire_deflate=pol.wire_deflate,
         broadcast=pol.broadcast or "identity",
         checkpoint=pol.checkpoint or "identity",
+        fused=pol.fused,
+        fused_chunk_kib=pol.fused_chunk_kib,
     )
     # Record the resolved quorum policy so a cross-rank config skew is
     # visible in the dumps.  The engines' own collectives stay exact —
@@ -354,9 +356,11 @@ def allreduce(
                 buf, op, prepare_fun=prepare_fun, cache_key=key
             )
     else:
+        engine = _get_engine()
         with obs.collective("allreduce", buf.nbytes, cache_key=key,
-                            codec=c.name):
-            out = _get_engine().allreduce_compressed(
+                            codec=c.name,
+                            fused=engine.fused_active(c, op)):
+            out = engine.allreduce_compressed(
                 buf, op, c, prepare_fun=prepare_fun, cache_key=key
             )
     return np.asarray(out).reshape(shape)

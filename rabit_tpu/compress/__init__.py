@@ -60,6 +60,10 @@ class Policy(NamedTuple):
     wire_deflate: bool = True  # lossless deflate stage on host wire bytes
     broadcast: str = ""        # byte codec for api.broadcast payloads
     checkpoint: str = "zlib"   # byte codec for durable store frames
+    fused: str = "auto"        # rabit_fused_allreduce: auto|1|0 — the fused
+                               # in-graph path (auto = on for XLA engines,
+                               # off elsewhere; engine/fused.py)
+    fused_chunk_kib: int = 256  # ppermute hop sub-chunk size (KiB)
 
 
 _POLICY = Policy()
@@ -77,6 +81,19 @@ def _numeric(name: str, what: str) -> str:
         raise ValueError(f"{what}: codec {name!r} is a byte codec, not a "
                          f"numeric array codec")
     return name
+
+
+#: accepted rabit_fused_allreduce spellings (doc/parameters.md)
+_FUSED_MODES = ("auto", "1", "0", "on", "off", "true", "false", "yes", "no",
+                "")
+
+
+def _fused_mode(value: str) -> str:
+    mode = value.strip().lower()
+    if mode not in _FUSED_MODES:
+        raise ValueError(
+            f"rabit_fused_allreduce={value!r}: want auto, 1/on, or 0/off")
+    return mode or "auto"
 
 
 def _bytes_codec(name: str, what: str) -> str:
@@ -105,6 +122,9 @@ def configure(config) -> Policy:
         checkpoint=_bytes_codec(
             config.get("rabit_checkpoint_compress", "zlib") or "",
             "rabit_checkpoint_compress"),
+        fused=_fused_mode(
+            config.get("rabit_fused_allreduce", "auto") or "auto"),
+        fused_chunk_kib=config.get_int("rabit_fused_chunk_kib", 256),
     )
     return _POLICY
 

@@ -89,6 +89,18 @@ DEFAULTS: dict[str, str] = {
     "rabit_compress_wire_deflate": "1",
     "rabit_compress_broadcast": "",
     "rabit_checkpoint_compress": "zlib",
+    # Fused in-XLA quantized collectives (rabit_tpu/engine/fused.py;
+    # doc/compression.md "Fused in-XLA path").  rabit_fused_allreduce:
+    # auto (default — ON for the XLA engine, meaningless elsewhere: the
+    # host transport is the only compressed path off-XLA) | 1 | 0.  When
+    # on, XlaEngine.allreduce_compressed lowers encode -> chunked
+    # ppermute ring (the PR 7 planned schedule order) -> rank-order
+    # decode-fold into ONE jitted graph, bitwise identical to the host
+    # reference fold.  rabit_fused_chunk_kib tunes the per-ppermute hop
+    # sub-chunk size (KiB; 0 = one ppermute per hop) for comm/compute
+    # overlap.
+    "rabit_fused_allreduce": "auto",
+    "rabit_fused_chunk_kib": "256",
     "rabit_debug": "0",
     # Observability (rabit_tpu/obs, doc/observability.md): when
     # rabit_obs_dir (or the RABIT_OBS_DIR env var) is set, each rank dumps

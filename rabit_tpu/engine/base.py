@@ -164,6 +164,17 @@ class Engine(ABC):
             deflate=_compress.policy().wire_deflate,
         )
 
+    def fused_active(self, codec, op) -> bool:
+        """True when ``allreduce_compressed(codec, op)`` will run as one
+        fused in-graph device collective (engine/fused.py) rather than the
+        host transport.  The obs layer stamps ``fused=1`` into the
+        collective identity from this answer, so Perfetto traces and the
+        straggler analytics can tell the two data planes apart.  Only the
+        XLA engine overrides this; everywhere else the host path is the
+        only compressed path (``rabit_fused_allreduce`` is off elsewhere
+        by construction)."""
+        return False
+
     # -- custom reduction --------------------------------------------------
 
     def allreduce_fn(

@@ -1,0 +1,299 @@
+"""Fused in-XLA quantized collectives — one jitted encode→ppermute→decode
+graph over the process mesh (ISSUE 11 tentpole; doc/compression.md, "Fused
+in-XLA path").
+
+PR 5's codecs cut allreduce wire bytes up to 4.79x, but every path except
+``XlaEngine.allreduce_compressed`` still round-trips the quantize →
+collective → dequantize pipeline through the host, and even that override
+leans on XLA's opaque AllReduce — the PR 7 planned ring order never reaches
+the device.  This module lowers the whole pipeline into ONE jitted graph
+(EQuARX-style fusion, PAPERS.md) expressed as a chunked ``lax.ppermute``
+ring whose source/dest table IS the planned schedule's ring order — Swing
+serpentine rings and degraded-link repaired rings included.
+
+Graph shape (one ``shard_map`` body, identical on every rank):
+
+1. **encode** — the local f32 shard quantizes on-device with the codec's
+   in-graph path (``codec.jax_encode``; bit-identical planes to the numpy
+   reference, asserted by tests/test_compress.py), after zero-padding to
+   ``world * slice_blocks`` scale blocks so every ring position owns an
+   equal block range;
+2. **reduce-scatter phase** — ``W-1`` ppermute hops along the planned ring.
+   Each hop moves QUANTIZED plane chunks with their per-block f32 scales
+   riding alongside (a chunk is the block-range slice of every wire
+   segment), pipelined so hop ``s`` carries the ``W-s`` chunks still in
+   transit: position ``p`` receives its own slice's chunk from the origin
+   ``s`` positions back and forwards the rest.  Per-rank wire cost is
+   ``(W-1)/2`` encoded planes — the hops carry int8/bf16, never f32;
+3. **decode-fold** — the slice owner dequantizes all ``W`` buffered chunks
+   in-register and folds them **in rank order** (never arrival/ring order),
+   so the fold is the exact closed form of
+   :func:`rabit_tpu.compress.transport.reference_allreduce` and the result
+   is bitwise identical for every schedule, replay, and world layout — the
+   host transport stays the reference oracle and the fallback for non-XLA
+   engines;
+4. **allgather phase** — ``W-1`` ppermute hops circulate the folded f32
+   slices; every rank assembles the identical full result.
+
+Determinism note: the decoded planes cross an identity ``ppermute`` before
+the fold.  XLA's CPU emitter otherwise contracts the dequant multiply into
+the fold add (an FMA skips the intermediate rounding the host's numpy fold
+performs), and a collective result is the one producer boundary the fuser
+never rematerializes across — measured: without the fence ~27% of summed
+elements drift in the last bit; with it every codec/op/schedule/world combo
+is bit-equal to the host fold.  ``lax.optimization_barrier`` does NOT stop
+the contraction on this backend.
+
+Chunking: ``rabit_fused_chunk_kib`` splits each hop's payload into at most
+that many KiB per ``ppermute`` issue, so XLA can overlap a chunk's transfer
+with the next chunk's packing (the "Efficient AllReduce with Stragglers"
+chunked-ring shape).  Parity is chunk-size independent (bytes are split,
+never re-encoded).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import numpy as np
+
+from rabit_tpu.compress.codecs import BLOCK, Codec, _BlockI8, get_codec
+from rabit_tpu.engine.base import MAX, MIN, SUM
+
+#: Default hop sub-chunk size (KiB) of the ppermute pipeline
+#: (``rabit_fused_chunk_kib``; 0 disables splitting).
+DEFAULT_CHUNK_KIB = 256
+
+#: Ops the fused fold covers (BITOR payloads are never codec-compressed).
+FUSED_OPS = (SUM, MAX, MIN)
+
+
+def chunk_bytes_from_config(config) -> int:
+    """Resolve ``rabit_fused_chunk_kib`` into bytes (doc/parameters.md)."""
+    return max(config.get_int("rabit_fused_chunk_kib", DEFAULT_CHUNK_KIB),
+               0) * 1024
+
+
+def fused_mode(config) -> bool:
+    """Resolve ``rabit_fused_allreduce`` for an XLA engine: ``auto``
+    (default) means ON — the key exists so deployments can force the host
+    transport (``0``) for debugging or pin the fused path explicitly
+    (``1``).  Non-XLA engines never consult it (off elsewhere: the host
+    transport is their only compressed path)."""
+    mode = (config.get("rabit_fused_allreduce", "auto") or "auto")
+    mode = mode.strip().lower()
+    if mode == "auto":
+        return True
+    return mode not in ("0", "false", "no", "off", "")
+
+
+def segment_widths(codec: Codec) -> tuple[int, ...]:
+    """Per-BLOCK byte width of each contiguous segment of the codec's wire
+    layout (plane-major, scales last — doc/compression.md).  Chunking by
+    scale-block ranges keeps every chunk a self-contained mini-wire: the
+    per-block scales ride alongside their payload blocks."""
+    if isinstance(codec, _BlockI8):
+        return tuple([BLOCK] * codec.planes + [4])
+    widths = {"identity": (4 * BLOCK,), "bf16": (2 * BLOCK,),
+              "bf16x2": (2 * BLOCK, 2 * BLOCK)}.get(codec.name)
+    if widths is None:
+        raise ValueError(
+            f"codec {codec.name!r} has no fused wire layout (host-only?)")
+    return widths
+
+
+def plan_ring_order(world: int, config) -> tuple[int, ...]:
+    """The ppermute source/dest table: the PR 7 planner's ring ORDER for
+    this world under the job's ``rabit_schedule``/``rabit_sched_mesh``
+    config.  The planner is a pure function of its inputs, so every
+    process derives the identical table with no tracker round-trip."""
+    from rabit_tpu import sched
+
+    knobs = sched.resolve(config)
+    mesh = sched.mesh_for_world(world, knobs["mesh"])
+    return sched.plan(world, knobs["schedule"], mesh).ring_order
+
+
+def _fold_fn(op: int):
+    import jax.numpy as jnp
+
+    if op == SUM:
+        return jnp.add
+    if op == MAX:
+        return jnp.maximum
+    if op == MIN:
+        return jnp.minimum
+    raise ValueError(f"unsupported fused op {op} (want one of {FUSED_OPS})")
+
+
+def build_fused_allreduce(mesh, ring_order, op: int, codec: Codec, n: int,
+                          chunk_bytes: int = DEFAULT_CHUNK_KIB * 1024
+                          ) -> Callable:
+    """Compile the fused graph for one (mesh, ring, op, codec, n) shape.
+
+    ``mesh`` is a 1-D jax Mesh with one device per rank, device ``i`` being
+    rank ``i``; ``ring_order[i]`` is the rank at ring position ``i`` (a
+    :class:`rabit_tpu.sched.Plan` ``ring_order``, or any permutation).
+    Returns a jitted callable taking a ``[world, n]`` f32 global array
+    sharded one row per device and returning the same shape with EVERY row
+    the identical rank-order fold — bit-equal to
+    ``reference_allreduce(rows, op, codec)``.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    axis = mesh.axis_names[0]
+    world = len(ring_order)
+    order = tuple(int(r) for r in ring_order)
+    if sorted(order) != list(range(world)):
+        raise ValueError(f"ring_order {order!r} is not a permutation of "
+                         f"0..{world - 1}")
+    if mesh.devices.size != world:
+        raise ValueError(f"mesh has {mesh.devices.size} devices for world "
+                         f"{world}")
+    if n < 1:
+        raise ValueError(f"fused allreduce needs n >= 1, got {n}")
+    fold = _fold_fn(op)
+
+    # Equal-slice geometry: pad to world * slice_blocks scale blocks so the
+    # ring moves identically-shaped chunks.  Zero padding is block-local in
+    # every codec, so the first n decoded elements are unaffected.
+    nb = -(-n // BLOCK)
+    slice_blocks = -(-nb // world)
+    nb_pad = slice_blocks * world
+    n_pad = nb_pad * BLOCK
+    widths = segment_widths(codec)
+    seg_offs = np.cumsum([0] + [w * nb_pad for w in widths])[:-1]
+    chunk_elems = slice_blocks * BLOCK
+    cb = sum(widths) * slice_blocks  # chunk wire bytes (planes + scales)
+
+    pos_of = np.zeros(world, np.int32)
+    for i, r in enumerate(order):
+        pos_of[r] = i
+    rank_at = np.array(order, np.int32)
+    perm = [(order[i], order[(i + 1) % world]) for i in range(world)]
+    ident_perm = [(i, i) for i in range(world)]
+
+    def pp(x):
+        """One planned-ring hop, split into <= chunk_bytes ppermutes so
+        transfer and packing pipeline (the rabit_fused_chunk_kib knob)."""
+        total = x.size * x.dtype.itemsize
+        if chunk_bytes <= 0 or total <= chunk_bytes:
+            return lax.ppermute(x, axis, perm)
+        nsplit = min(-(-total // chunk_bytes), x.shape[-1])
+        parts = jnp.array_split(x, nsplit, axis=x.ndim - 1)
+        return jnp.concatenate([lax.ppermute(part, axis, perm)
+                                for part in parts], axis=x.ndim - 1)
+
+    def extract(wire, p: int):
+        """Chunk for ring position ``p``: the block-range slice of every
+        wire segment, concatenated — a self-contained mini-wire for
+        ``chunk_elems`` elements (scales ride with their blocks)."""
+        parts = [lax.slice_in_dim(wire, int(o) + p * slice_blocks * w,
+                                  int(o) + (p + 1) * slice_blocks * w)
+                 for o, w in zip(seg_offs, widths)]
+        return jnp.concatenate(parts) if len(parts) > 1 else parts[0]
+
+    def body(xrow):
+        x = xrow.reshape(-1)
+        if n_pad != n:
+            x = jnp.pad(x, (0, n_pad - n))
+        wire = codec.jax_encode(x)  # quantized planes, on device
+        me = lax.axis_index(axis)
+        my_pos = jnp.asarray(pos_of)[me]
+        chunks = jnp.stack([extract(wire, p) for p in range(world)])
+        # Reduce-scatter phase: buffer every origin's chunk for MY slice,
+        # indexed by origin RANK so the fold below runs in rank order.
+        buf = jnp.zeros((world, cb), jnp.uint8)
+        buf = lax.dynamic_update_index_in_dim(
+            buf, jnp.take(chunks, my_pos, axis=0), me, 0)
+        if world > 1:
+            # Hop pipeline: I inject all my foreign chunks ordered by ring
+            # distance; each received list's head is addressed to me (from
+            # the origin s positions back) and the tail forwards onward.
+            send = jnp.stack([jnp.take(chunks, (my_pos + d) % world, axis=0)
+                              for d in range(1, world)])
+            for s in range(1, world):
+                recv = pp(send)
+                origin = jnp.asarray(rank_at)[(my_pos - s) % world]
+                buf = lax.dynamic_update_index_in_dim(buf, recv[0], origin, 0)
+                send = recv[1:]
+        dec = jax.vmap(lambda row: codec.jax_decode(row, chunk_elems))(buf)
+        if world > 1:
+            # Rounding fence (module docstring): without it XLA contracts
+            # the dequant multiply into the fold add and the low bits drift
+            # off the host oracle.
+            dec = lax.ppermute(dec, axis, ident_perm)
+            acc = lax.fori_loop(
+                1, world,
+                lambda r, a: fold(a, lax.dynamic_index_in_dim(
+                    dec, r, 0, keepdims=False)),
+                dec[0])
+        else:
+            acc = dec[0]
+        # Allgather phase: circulate the folded f32 slices; slice of ring
+        # position p lands at block range [p*slice_blocks, (p+1)*...).
+        out = jnp.zeros((world, chunk_elems), jnp.float32)
+        out = lax.dynamic_update_index_in_dim(out, acc, my_pos, 0)
+        cur = acc
+        for s in range(1, world):
+            cur = pp(cur)
+            out = lax.dynamic_update_index_in_dim(
+                out, cur, (my_pos - s) % world, 0)
+        return out.reshape(-1)[:n][None]
+
+    mapped = shard_map(body, mesh=mesh, in_specs=P(axis, None),
+                       out_specs=P(axis, None), check_rep=False)
+    return jax.jit(mapped)
+
+
+# -- single-process harness (tests, benches) ---------------------------------
+
+def local_mesh(world: int):
+    """A 1-D mesh over the first ``world`` local devices — the CPU-mesh
+    stand-in for the engine's one-device-per-process mesh (tests pin an
+    8-device virtual CPU platform; tests/conftest.py)."""
+    import jax
+    from jax.sharding import Mesh
+
+    devs = jax.devices()
+    if len(devs) < world:
+        raise RuntimeError(
+            f"local fused mesh needs {world} devices, have {len(devs)}")
+    return Mesh(np.array(devs[:world]), ("r",))
+
+
+def place_contributions(mesh, contribs):
+    """Stack per-rank f32 contributions into the fused graph's input: a
+    ``[world, n]`` global array, one row per device."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    stacked = np.stack([np.ascontiguousarray(c, np.float32).reshape(-1)
+                        for c in contribs])
+    return jax.device_put(
+        stacked, NamedSharding(mesh, P(mesh.axis_names[0], None)))
+
+
+def run_local(contribs, op: int, codec, ring_order=None,
+              chunk_bytes: int = DEFAULT_CHUNK_KIB * 1024) -> np.ndarray:
+    """Build and run the fused graph over local devices, one rank per
+    device; asserts the output is replicated bit-identically across ranks
+    and returns it.  The parity gate's driver
+    (tests/test_fused.py: fused ≡ ``reference_allreduce``)."""
+    c = codec if isinstance(codec, Codec) else get_codec(codec)
+    world = len(contribs)
+    mesh = local_mesh(world)
+    order = tuple(ring_order) if ring_order is not None else tuple(
+        range(world))
+    n = np.ascontiguousarray(contribs[0]).size
+    fn = build_fused_allreduce(mesh, order, op, c, n, chunk_bytes)
+    out = np.asarray(fn(place_contributions(mesh, contribs)))
+    for r in range(1, world):
+        if not np.array_equal(out[0], out[r]):
+            raise AssertionError(
+                f"fused allreduce diverged: rank {r} != rank 0")
+    return out[0]

@@ -42,6 +42,13 @@ class XlaEngine(Engine):
         # compiled (encode, decode+fold) pairs of the compressed path,
         # per (op, codec, element count)
         self._cjits: dict[tuple, tuple[Callable, Callable]] = {}
+        # compiled fused encode->ppermute->decode-fold graphs
+        # (engine/fused.py), per (op, codec, element count)
+        self._fjits: dict[tuple, Callable] = {}
+        self._fused_order: tuple[int, ...] | None = None
+        # rabit_fused_allreduce, resolved lazily at the first compressed
+        # collective (None = not resolved yet)
+        self._fused_on: bool | None = None
 
     def init(self) -> None:
         import jax
@@ -92,6 +99,9 @@ class XlaEngine(Engine):
     def shutdown(self) -> None:
         self._mesh = None
         self._jits.clear()
+        self._cjits.clear()
+        self._fjits.clear()
+        self._fused_order = None
 
     def rebuild_mesh(self) -> None:
         """Adopt a resized world (rabit_tpu.elastic): drop every compiled
@@ -108,6 +118,10 @@ class XlaEngine(Engine):
         self._mesh = None
         self._jits.clear()
         self._cjits.clear()
+        # the fused graphs bake the OLD world's ring order and device set
+        # into their ppermute tables — stale after a resize
+        self._fjits.clear()
+        self._fused_order = None
         self._rank = jax.process_index()
         self._world = jax.process_count()
         delta = resize_ring(old_world, max(self._world, 1))
@@ -245,14 +259,46 @@ class XlaEngine(Engine):
             )
         return self._cjits[key]
 
+    def fused_active(self, codec, op) -> bool:
+        """True when :meth:`allreduce_compressed` will take the fused
+        in-graph ppermute path for this (codec, op) — the obs layer stamps
+        ``fused=1`` into the collective identity from this answer."""
+        if self._fused_on is None:
+            from rabit_tpu.engine.fused import fused_mode
+
+            self._fused_on = fused_mode(self.config)
+        return (self._fused_on and self.get_world_size() > 1
+                and codec.has_jax and op in (SUM, MAX, MIN))
+
+    def _fused_fn(self, op: int, codec, n: int):
+        """Jitted fused encode→ppermute→decode-fold graph over the process
+        mesh (engine/fused.py), the ppermute table taken from the PR 7
+        planned ring order for this world."""
+        key = (op, codec.name, n)
+        if key not in self._fjits:
+            from rabit_tpu.engine import fused as _fused
+
+            mesh = self._proc_mesh()
+            if self._fused_order is None:
+                self._fused_order = _fused.plan_ring_order(
+                    self._world, self.config)
+            self._fjits[key] = _fused.build_fused_allreduce(
+                mesh, self._fused_order, op, codec, n,
+                chunk_bytes=_fused.chunk_bytes_from_config(self.config))
+        return self._fjits[key]
+
     def allreduce_compressed(self, data, op, codec, prepare_fun=None,
                              cache_key=None):
-        """On-device quantized allreduce: encode this process's shard to
-        the codec's packed planes on device, run ONE fused collective over
-        the process mesh (the wire carries the encoded planes), decode and
-        fold on device with a replicated output.  Falls back to the numpy
-        host transport for solo worlds, host-only codecs, and ops the
-        device fold does not cover."""
+        """On-device quantized allreduce.  Default (rabit_fused_allreduce
+        auto/on): the fully fused path — ONE jitted graph runs encode, a
+        chunked ppermute ring in the planned schedule order (reduce-scatter
+        + allgather phases, hops carry quantized planes), and the
+        rank-order decode-fold, bitwise identical to the host reference
+        fold.  rabit_fused_allreduce=0 keeps the pre-fusion shape: jitted
+        on-device encode + one XLA-chosen collective over packed planes +
+        jitted decode-fold.  Falls back to the numpy host transport for
+        solo worlds (no mesh/jit is ever built for a no-op collective),
+        host-only codecs, and ops the device fold does not cover."""
         if prepare_fun is not None:
             prepare_fun(data)
         arr = np.ascontiguousarray(data)
@@ -267,10 +313,28 @@ class XlaEngine(Engine):
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         n = arr.size
+        mesh = self._proc_mesh()
+        if self.fused_active(codec, op):
+            fn = self._fused_fn(op, codec, n)
+            t0 = _time.perf_counter()
+            sharding = NamedSharding(mesh, P("p", None))
+            local = jax.device_put(arr.reshape(1, -1),
+                                   mesh.devices[self._rank])
+            garr = jax.make_array_from_single_device_arrays(
+                (self._world, n), sharding, [local]
+            )
+            out = fn(garr)
+            result = np.asarray(out.addressable_data(0)).reshape(arr.shape)
+            # wire accounting: the ring moves (W-1)/W encoded chunk sets
+            # per phase; meter the canonical per-rank encoded size so the
+            # codec ratios stay comparable with the host path's meter
+            _compress.observe(codec.name, raw=arr.nbytes,
+                              wire=codec.wire_len(n),
+                              encode_s=_time.perf_counter() - t0)
+            return result
         encode, fold = self._compressed_fns(op, codec, n)
         t0 = _time.perf_counter()
         packed = encode(arr.reshape(-1))  # on the local device
-        mesh = self._proc_mesh()
         wire_len = codec.wire_len(n)
         sharding = NamedSharding(mesh, P("p", None))
         local = jax.device_put(packed[None], mesh.devices[self._rank])

@@ -7,6 +7,13 @@ allreduce per (dtype, op, codec) group.  Works against any engine — the
 XLA engine turns the flush into a single fused device collective (a
 compressed group's planes are encoded on-device, so the fused buffer still
 crosses as one collective); the native engine into one TCP tree/ring pass.
+
+A compressed group's flush routes through ``api.allreduce(codec=...)``,
+so under ``rabit_fused_allreduce`` (auto on the XLA engine) the whole
+group — planes, scales and all — runs as ONE jitted
+encode→ppermute→decode-fold graph over the process mesh
+(engine/fused.py): host-side fusion picks the buffers, in-XLA fusion
+moves them, and the op_begin/op_end identity carries ``fused=1``.
 """
 
 from __future__ import annotations

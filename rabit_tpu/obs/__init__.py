@@ -237,7 +237,7 @@ def collective_seq() -> tuple[int, int]:
 
 @contextlib.contextmanager
 def collective(op: str, nbytes: int, cache_key: str | None = None,
-               codec: str | None = None):
+               codec: str | None = None, fused: bool = False):
     """The one timing/eventing path for every public collective: records
     ``op_begin``/``op_end`` events stamped with the cross-rank
     ``(version, seqno)`` identity, marks the thread in-flight for the hang
@@ -251,7 +251,11 @@ def collective(op: str, nbytes: int, cache_key: str | None = None,
     skew shows up as differing ``codec`` fields on the same identity in
     the merged cross-rank trace — a detectable error, not silent
     corruption (the wire transport additionally hard-fails on mismatched
-    frame ids; doc/compression.md, "Replay safety")."""
+    frame ids; doc/compression.md, "Replay safety").
+
+    ``fused=True`` marks a collective the engine runs as one fused
+    in-graph device op (engine/fused.py): ``fused=1`` joins both events so
+    traces and straggler analytics distinguish fused from host-path ops."""
     tid = threading.get_ident()
     with _STATE.lock:
         version, seqno = _STATE.op_version, _STATE.op_seq
@@ -259,6 +263,8 @@ def collective(op: str, nbytes: int, cache_key: str | None = None,
         _STATE.inflight[tid] = (op, cache_key, time.monotonic(), version,
                                 seqno)
     extra = {} if codec is None else {"codec": codec}
+    if fused:
+        extra["fused"] = 1
     record_event("op_begin", op=op, nbytes=nbytes, cache_key=cache_key,
                  version=version, seqno=seqno, **extra)
     t0 = time.perf_counter()

@@ -233,6 +233,9 @@ class OpSpan:
     end: float | None = None  # None: still in flight at dump time
     nbytes: int = 0
     cache_key: str | None = None
+    # engine/fused.py ran this op as one fused in-graph device collective
+    # (the op_begin/op_end events carry fused=1); host-path ops stay False
+    fused: bool = False
 
     @property
     def keyed(self) -> bool:
@@ -260,6 +263,7 @@ def pair_ops(events: list[Event]) -> list[OpSpan]:
                 begin=ev.ts,
                 nbytes=int(ev.fields.get("nbytes") or 0),
                 cache_key=ev.fields.get("cache_key"),
+                fused=bool(ev.fields.get("fused")),
             )
             spans.append(span)
             if span.keyed:
@@ -388,6 +392,8 @@ def build_chrome_trace(job: JobTrace) -> dict:
                 args.update(version=span.version, seqno=span.seqno)
             if span.cache_key:
                 args["cache_key"] = span.cache_key
+            if span.fused:
+                args["fused"] = 1
             out.append({
                 "name": span.op, "cat": "collective", "ph": "X",
                 "ts": _us(span.begin + off, t_base),
@@ -567,11 +573,16 @@ def straggler_report(job: JobTrace, top_k: int = 3) -> dict:
         first, last = min(begins), max(begins)
         last_rank = max(ranks, key=lambda r: ranks[r].begin)
         version, seqno, op = key
-        worst.append({"op": op, "version": version, "seqno": seqno,
-                      "skew_s": round(last - first, 6),
-                      "first_enter_s": round(first, 6),
-                      "last_enter_s": round(last, 6),
-                      "last_rank": last_rank})
+        entry = {"op": op, "version": version, "seqno": seqno,
+                 "skew_s": round(last - first, 6),
+                 "first_enter_s": round(first, 6),
+                 "last_enter_s": round(last, 6),
+                 "last_rank": last_rank}
+        if any(s.fused for s in ranks.values()):
+            # fused-path skew is device-graph scheduling, not host encode
+            # latency — keep the two data planes separable in the report
+            entry["fused"] = 1
+        worst.append(entry)
         for rank, span in ranks.items():
             stats = per_rank[rank]
             stats["arrivals"] += 1
