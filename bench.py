@@ -118,6 +118,14 @@ HA_CHILD_TIMEOUT = 180.0
 # >=1 MiB payloads).  Deducted from the TPU budget like the other riders;
 # RABIT_BENCH_FUSED=0 skips it.
 FUSED_BENCH = os.environ.get("RABIT_BENCH_FUSED", "1") != "0"
+# Multi-tenant service bench (ISSUE 12): N concurrent jobs through one
+# CollectiveService + shared relay tier — jobs/sec, p99 bootstrap
+# latency, noisy-neighbor isolation under a straggler storm, pooled-
+# worker fit throughput (tools/service_bench.py --smoke;
+# doc/service.md) in a CPU child; deducted from the TPU budget like the
+# other riders; RABIT_BENCH_SERVICE=0 skips it.
+SERVICE_BENCH = os.environ.get("RABIT_BENCH_SERVICE", "1") != "0"
+SERVICE_CHILD_TIMEOUT = 180.0
 FUSED_CHILD_TIMEOUT = 180.0
 FUSED_WORLD = 4
 FUSED_ELEMS = 1 << 18  # 1 MiB of f32 — the acceptance bar's payload floor
@@ -541,6 +549,34 @@ def run_ha_bench(timeout=HA_CHILD_TIMEOUT):
             log(f"ha failover child rc={r.returncode}")
     except subprocess.TimeoutExpired:
         log(f"ha failover child timed out after {timeout:.0f}s")
+    return lines
+
+
+def run_service_bench(timeout=SERVICE_CHILD_TIMEOUT):
+    """Multi-tenant service records (tools/service_bench.py --smoke) in
+    a child: one CollectiveService, 8 concurrent jobs, a shared relay
+    tier, a straggler-stormed victim job, and a pooled-worker arm
+    (threads + real sockets; a child so a wedged run cannot stall the
+    driver).  Returns the record list, empty on timeout/failure."""
+    cmd = [sys.executable,
+           os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "tools", "service_bench.py"), "--smoke"]
+    lines = []
+    try:
+        r = subprocess.run(cmd, timeout=timeout, capture_output=True,
+                           text=True)
+        if r.returncode == 0:
+            for line in r.stdout.strip().splitlines():
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if isinstance(rec, dict) and rec.get("bench") == "service":
+                    lines.append(rec)
+        else:
+            log(f"service bench child rc={r.returncode}")
+    except subprocess.TimeoutExpired:
+        log(f"service bench child timed out after {timeout:.0f}s")
     return lines
 
 
@@ -1032,6 +1068,14 @@ def main():
                          min(tpu_budget, 300.0))
         log(f"fused A/B bench: {len(fused_lines)} line(s); "
             f"TPU budget now {tpu_budget:.0f}s")
+    service_lines = []
+    if SERVICE_BENCH:
+        t_sv = time.time()
+        service_lines = run_service_bench()
+        tpu_budget = max(tpu_budget - (time.time() - t_sv),
+                         min(tpu_budget, 300.0))
+        log(f"service bench: {len(service_lines)} line(s); "
+            f"TPU budget now {tpu_budget:.0f}s")
     probe_daemon = ProbeDaemon().start()
     # start paused: attempt 1 launches immediately and owns the chip; the
     # child's teardown resumes the cadence for the probe-gated retries
@@ -1079,6 +1123,8 @@ def main():
             rec["ha_failover"] = ha_lines
         if fused_lines:
             rec["fused_ab"] = fused_lines
+        if service_lines:
+            rec["service"] = service_lines
         print(json.dumps(rec), flush=True)
         return
     device_time = res["device_time"]
@@ -1139,6 +1185,8 @@ def main():
         rec["ha_failover"] = ha_lines
     if fused_lines:
         rec["fused_ab"] = fused_lines
+    if service_lines:
+        rec["service"] = service_lines
     print(json.dumps(rec), flush=True)
 
 

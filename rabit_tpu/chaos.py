@@ -563,7 +563,8 @@ def run_elastic_schedule(seed: int, world: int | None = None,
                          relay_flush: float = 0.1,
                          heartbeat_sec: float = 0.15,
                          failover: FaultSpec | None = None,
-                         takeover_sec: float = 0.5) -> ElasticScheduleResult:
+                         takeover_sec: float = 0.5,
+                         job: str = "") -> ElasticScheduleResult:
     """One fuzzed shrink/grow scenario (deterministic per seed).
 
     A seeded mix of elastic failure shapes against a real elastic tracker:
@@ -636,6 +637,11 @@ def run_elastic_schedule(seed: int, world: int | None = None,
     bitwise assert below applies unchanged across the merged
     primary+standby event timeline.  ``standby_death=at_s`` kills the
     standby instead — the job must ride the primary, unbothered.
+
+    ``job`` namespaces every worker's wire task id ("<job>/<task>",
+    doc/service.md) so the whole fuzzed scenario can run as ONE tenant
+    of a multi-job CollectiveService; the default empty key keeps the
+    legacy ids (and the result dict's task-id keys) byte-identical.
 
     Quorum correctness asserts: every completed worker's final state is
     BITWISE IDENTICAL; with a single epoch the state equals the closed
@@ -827,7 +833,9 @@ def run_elastic_schedule(seed: int, world: int | None = None,
     def run_worker(w: "ElasticWorker") -> None:
         res = w.run()
         with lock:
-            results[w.task_id] = res
+            # keyed by the job-LOCAL id: the asserts below reason about
+            # "worker i", whatever tenant namespace the run used
+            results[P.split_job(w.task_id)[1]] = res
 
     threads = []
     workers: list["ElasticWorker"] = []
@@ -845,7 +853,7 @@ def run_elastic_schedule(seed: int, world: int | None = None,
                           wave_timeout=10.0, link_timeout=link_to,
                           deadline_sec=deadline_sec, fail=fail,
                           quorum=quorum, quorum_wait=quorum_wait,
-                          codec=codec)
+                          codec=codec, job=job)
         workers.append(w)
         threads.append(threading.Thread(target=run_worker, args=(w,),
                                         daemon=True))
@@ -881,7 +889,7 @@ def run_elastic_schedule(seed: int, world: int | None = None,
                           deadline_sec=max(deadline_sec
                                            - (time.monotonic() - t0), 1.0),
                           fail=fail, quorum=quorum,
-                          quorum_wait=quorum_wait, codec=codec)
+                          quorum_wait=quorum_wait, codec=codec, job=job)
         with lock:
             spare_workers.append(w)
         run_worker(w)

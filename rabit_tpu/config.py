@@ -192,6 +192,24 @@ DEFAULTS: dict[str, str] = {
     # lease — how long the primary may be unreachable/silent before the
     # standby promotes itself; rabit_ha_tick_sec: the primary's journal
     # keepalive cadence (the liveness signal that lease watches).
+    # Multi-tenant collective service (rabit_tpu/service, doc/service.md).
+    # rabit_job_key: the job this worker belongs to — it prefixes the
+    # wire task id ("<job>/<task>"; empty = the legacy single-job
+    # namespace, byte-identical on the wire) so a CollectiveService
+    # routes the worker to its job's control-plane partition.
+    # rabit_service_max_jobs / rabit_service_max_jobs_per_tenant /
+    # rabit_service_max_ranks: the service's admission quotas
+    # (concurrent jobs service-wide, concurrent jobs per tenant — the
+    # job key up to its first "." — and the fd budget as the sum of
+    # admitted world sizes; 0 = unlimited).  rabit_service_auto_world:
+    # world size for jobs admitted straight from the wire (an unknown
+    # job key's first check-in); 0 refuses unknown keys — programmatic
+    # admission only.
+    "rabit_job_key": "",
+    "rabit_service_max_jobs": "0",
+    "rabit_service_max_jobs_per_tenant": "0",
+    "rabit_service_max_ranks": "0",
+    "rabit_service_auto_world": "0",
     "rabit_tracker_addrs": "",
     "rabit_ha_journal": "",
     "rabit_ha_snapshot_every": "256",

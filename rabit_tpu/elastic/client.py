@@ -145,6 +145,7 @@ class ElasticWorker:
         quorum: str = "",
         quorum_wait: float = 0.35,
         codec: str = "",
+        job: str = "",
     ):
         # ``tracker`` is one (host, port) or a failover LIST of them
         # (rabit_tracker_addrs, doc/ha.md: the primary first, then its
@@ -157,7 +158,11 @@ class ElasticWorker:
             self.addrs = [(tracker[0], int(tracker[1]))]
         self.tracker = self.addrs[0]
         self._active = 0  # index of the address that last answered
-        self.task_id = task_id
+        # The optional job key prefixes the wire task id ("job/task",
+        # protocol.join_job) so a multi-job CollectiveService routes
+        # this worker to its job's partition; empty = the legacy
+        # single-job namespace, byte-identical (doc/service.md).
+        self.task_id = P.join_job(job, task_id)
         self.contribution = contribution
         self.niter = int(niter)
         self.spare = bool(spare)

@@ -82,7 +82,8 @@ class Journal:
                  snapshot_every: int = 256,
                  state: ControlState | None = None,
                  on_event: Callable[[dict], None] | None = None,
-                 fsync: bool = False):
+                 fsync: bool = False,
+                 seeded: bool | None = None):
         self.path = path
         self.codec = codec
         self.snapshot_every = max(int(snapshot_every), 1)
@@ -97,11 +98,15 @@ class Journal:
         self.n_appended = 0
         self.n_snapshots = 0
         self._closed = threading.Event()
-        # A caller-supplied state is AUTHORITATIVE (a promoted standby
-        # already replayed this very file / its stream): the existing
-        # file is compacted over, never re-applied — replaying it into
-        # the supplied state would double-count every record.
-        self._seeded = state is not None
+        # A caller-supplied state is AUTHORITATIVE by default (a promoted
+        # standby already replayed this very file / its stream): the
+        # existing file is compacted over, never re-applied — replaying
+        # it into the supplied state would double-count every record.
+        # ``seeded=False`` overrides that for a caller that supplies a
+        # FRESH custom mirror (a CollectiveService's multi-job
+        # ServiceState, doc/service.md) and wants the file replayed into
+        # it.
+        self._seeded = (state is not None) if seeded is None else bool(seeded)
         if path:
             self._bootstrap_file(path)
         self._thread = threading.Thread(target=self._run, daemon=True,

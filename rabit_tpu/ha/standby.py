@@ -62,10 +62,22 @@ class Standby:
                  poll_sec: float = 0.1,
                  journal: str | None = None,
                  tracker_kwargs: dict | None = None,
-                 quiet: bool = True):
+                 quiet: bool = True,
+                 service: bool = False):
         if primary is None and journal_path is None:
             raise ValueError("standby needs a primary address and/or a "
                              "journal path to tail")
+        # Multi-job mode (doc/service.md): the tailed journal belongs to
+        # a CollectiveService — replay into a ServiceState (every job's
+        # partition restored from the ONE interleaved record stream) and
+        # promote a CollectiveService instead of a single-job Tracker.
+        self.service = bool(service)
+        if service:
+            from rabit_tpu.service.state import ServiceState
+
+            self._state_cls = ServiceState
+        else:
+            self._state_cls = ControlState
         self.primary = ((primary[0], int(primary[1]))
                         if primary is not None else None)
         self.journal_path = journal_path
@@ -78,7 +90,7 @@ class Standby:
             else journal_path
         self.tracker_kwargs = dict(tracker_kwargs or {})
         self.quiet = quiet
-        self.state = ControlState()
+        self.state = self._state_cls()
         self.events: list[dict] = []  # seeded into the promoted tracker
         self.synced = threading.Event()     # first snapshot applied
         self.promoted = threading.Event()
@@ -153,7 +165,7 @@ class Standby:
         for kind, fields in records:
             if kind == "snapshot" and self.synced.is_set():
                 mine = self.state.snapshot_bytes()
-                theirs = ControlState.from_snapshot(
+                theirs = self._state_cls.from_snapshot(
                     fields["state"]).snapshot_bytes()
                 if mine != theirs:
                     # Divergence means records were lost or applied
@@ -291,10 +303,13 @@ class Standby:
 
         if self._stop.is_set():
             return
-        self._note({"kind": "tracker_failover",
-                    "standby": self.standby_id,
-                    "epoch": self.state.epoch, "world": self.state.world,
-                    "synced": self.synced.is_set()})
+        ev = {"kind": "tracker_failover",
+              "standby": self.standby_id,
+              "epoch": self.state.epoch, "world": self.state.world,
+              "synced": self.synced.is_set()}
+        if self.service:
+            ev["jobs"] = self.state.n_jobs
+        self._note(ev)
         kwargs = dict(self.tracker_kwargs)
         kwargs.setdefault("quiet", self.quiet)
         journal = None
@@ -303,12 +318,25 @@ class Standby:
         # listen() happens inside Tracker (listen_sock=): the pre-bound
         # socket starts refusing dials only now, which is exactly when
         # the client-side rotation should start landing here.
-        tracker = Tracker(
-            self.state.base_world or self.state.world or 1,
-            listen_sock=self._sock,
-            resume_from=self.state,
-            journal=journal,
-            **kwargs)
+        if self.service:
+            # Promote a full multi-job service: every live job's
+            # partition is re-admitted from the replayed ServiceState
+            # (doc/service.md) — one journal, BOTH (all) jobs restored.
+            from rabit_tpu.service.service import CollectiveService
+
+            tracker = CollectiveService(
+                self.state.world or 1,
+                listen_sock=self._sock,
+                resume_from=self.state,
+                journal=journal,
+                **kwargs)
+        else:
+            tracker = Tracker(
+                self.state.base_world or self.state.world or 1,
+                listen_sock=self._sock,
+                resume_from=self.state,
+                journal=journal,
+                **kwargs)
         with self._lock:
             tracker.events[:0] = self.events
         self.tracker = tracker

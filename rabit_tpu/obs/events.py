@@ -124,6 +124,16 @@ KINDS: dict[str, str] = {
                       "world",
     "tracker_failover": "standby promoted itself over the dead primary: "
                         "standby, epoch, world, synced",
+    # multi-tenant collective service (rabit_tpu/service, doc/service.md)
+    "job_admitted": "a job passed admission and got its partition: job, "
+                    "world, tenant, pooled (restored=True after a "
+                    "failover/journal replay)",
+    "admission_refused": "a job hit a quota / bad key and was refused: "
+                         "job, tenant, reason",
+    "worker_leased": "a parked pool worker was leased into a job's "
+                     "wave: task_id, job, pool",
+    "job_completed": "a job finished and its partition retired: job, "
+                     "world, seconds",
     # collective schedules (rabit_tpu/sched, doc/scheduling.md)
     "schedule_planned": "tracker planned a wave's schedule: epoch, algo, "
                         "ring_order, n_avoided",

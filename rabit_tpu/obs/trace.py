@@ -168,8 +168,17 @@ def discover_dumps(obs_dir: str) -> list[str]:
             if n.startswith("flight-") and n.endswith(".jsonl")]
 
 
-def load_job(obs_dir: str) -> JobTrace:
+def telemetry_name(job_key: str = "") -> str:
+    """The telemetry filename for one job of a shared obs dir
+    (doc/service.md): ``telemetry-<job>.json`` under a multi-job
+    service, the bare legacy name for the single-job path."""
+    return f"telemetry-{job_key}.json" if job_key else "telemetry.json"
+
+
+def load_job(obs_dir: str, job_key: str = "") -> JobTrace:
     """Join every flight dump + telemetry.json under ``obs_dir``.
+    ``job_key`` selects one job's telemetry file of a shared multi-job
+    obs dir (:func:`telemetry_name`).
 
     Multiple dumps per rank (several lives, or hang-then-exit in one life)
     are merged: events are pooled, exact duplicates (same ts/kind/fields —
@@ -203,13 +212,14 @@ def load_job(obs_dir: str) -> JobTrace:
     for rank, pool in pools.items():
         job.ranks[rank] = sorted(pool.values(), key=lambda e: e.ts)
 
-    tele_path = os.path.join(obs_dir, "telemetry.json")
+    tele_path = os.path.join(obs_dir, telemetry_name(job_key))
     if os.path.exists(tele_path):
         try:
             with open(tele_path) as f:
                 job.telemetry = json.load(f)
         except (OSError, ValueError) as exc:
-            raise TraceError(f"unreadable telemetry.json: {exc!r}") from exc
+            raise TraceError(f"unreadable {os.path.basename(tele_path)}: "
+                             f"{exc!r}") from exc
         clocks = dict(job.telemetry.get("clocks") or {})
         for r, snap in (job.telemetry.get("ranks") or {}).items():
             if isinstance(snap, dict) and snap.get("clock"):
@@ -325,6 +335,8 @@ _TRACKER_INSTANTS = {
     "relay_up", "relay_lost", "batch_folded", "messages_dropped",
     "journal_snapshot", "journal_gap", "standby_synced",
     "tracker_failover",
+    "job_admitted", "admission_refused", "worker_leased",
+    "job_completed",
 }
 
 
@@ -619,18 +631,20 @@ def straggler_report(job: JobTrace, top_k: int = 3) -> dict:
 
 # -- persistence -------------------------------------------------------------
 
-def fold_into_telemetry(obs_dir: str, report: dict) -> str | None:
-    """Write the straggler aggregates back into telemetry.json under a
-    ``stragglers`` key (atomic rewrite).  Returns the path, or None when
-    there is no telemetry.json to fold into."""
-    path = os.path.join(obs_dir, "telemetry.json")
+def fold_into_telemetry(obs_dir: str, report: dict,
+                        job_key: str = "") -> str | None:
+    """Write the straggler aggregates back into the (job's) telemetry
+    file under a ``stragglers`` key (atomic rewrite).  Returns the path,
+    or None when there is no telemetry file to fold into."""
+    path = os.path.join(obs_dir, telemetry_name(job_key))
     if not os.path.exists(path):
         return None
     try:
         with open(path) as f:
             doc = json.load(f)
     except (OSError, ValueError) as exc:
-        raise TraceError(f"cannot fold into telemetry.json: {exc!r}") from exc
+        raise TraceError(f"cannot fold into "
+                         f"{os.path.basename(path)}: {exc!r}") from exc
     doc["stragglers"] = report
     tmp = f"{path}.tmp.{os.getpid()}"
     with open(tmp, "w") as f:
@@ -640,23 +654,25 @@ def fold_into_telemetry(obs_dir: str, report: dict) -> str | None:
 
 
 def export_job(obs_dir: str, out_path: str | None = None,
-               fold: bool = True, top_k: int = 3) -> tuple[dict, str, dict]:
+               fold: bool = True, top_k: int = 3,
+               job_key: str = "") -> tuple[dict, str, dict]:
     """The one-call export path (what ``trace_tool.py export`` and the CI
     gate run): load, merge, build, self-validate, write, and fold the
-    straggler aggregates back into telemetry.json.  Returns
+    straggler aggregates back into the (job's) telemetry file.  Returns
     ``(trace_doc, written_path, straggler_report)``."""
-    job = load_job(obs_dir)
+    job = load_job(obs_dir, job_key=job_key)
     doc = build_chrome_trace(job)
     errs = validate_chrome_trace(doc)
     if errs:
         raise TraceError("export produced an invalid trace: "
                          + "; ".join(errs[:5]))
-    out_path = out_path or os.path.join(obs_dir, "trace.json")
+    out_path = out_path or os.path.join(
+        obs_dir, f"trace-{job_key}.json" if job_key else "trace.json")
     tmp = f"{out_path}.tmp.{os.getpid()}"
     with open(tmp, "w") as f:
         json.dump(doc, f, sort_keys=True)
     os.replace(tmp, out_path)
     report = straggler_report(job, top_k=top_k)
     if fold:
-        fold_into_telemetry(obs_dir, report)
+        fold_into_telemetry(obs_dir, report, job_key=job_key)
     return doc, out_path, report

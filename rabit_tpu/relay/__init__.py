@@ -33,7 +33,17 @@ root tracker:
   round, and re-delivery after a channel cut is safe because the
   tracker's QuorumTable decides each round exactly once;
 * **proxied** — CMD_BLOB (rank-0 blob upload: large and rare) passes
-  straight through on its own short-lived upstream connection;
+  through on its own short-lived upstream connection, behind a per-job
+  (job, version) cache: re-uploads of a version the root already ACKed
+  are answered locally (``blob_cache_hits``), a version bump
+  invalidates and proxies — N children re-shipping one bootstrap blob
+  cost the root one fetch;
+* **job-multiplexed** — children of a multi-job CollectiveService
+  (doc/service.md) need no relay configuration: the job key rides
+  inside their task ids (so routed replies and held check-ins are
+  per-job automatically), and the batch ACK's ``jobs`` map keeps a
+  per-job CMD_EPOCH cache so one relay tier serves every job's
+  version-boundary polls locally;
 * **clock-projected** — the relay brackets every batch round-trip and
   keeps an NTP-style offset estimate against the tracker clock; child
   heartbeat/metrics ACKs carry the PROJECTED tracker time, so PR 3
@@ -163,6 +173,18 @@ class Relay:
         self.clock_offset = 0.0   # tracker_ts - relay_ts
         self.clock_err = float("inf")
         self._epoch_cache = {"epoch": 0, "world": 0, "rewave": False}
+        # Multi-job service (doc/service.md): a CollectiveService's
+        # batch ACK carries a per-job "jobs" map — children of job "j"
+        # (task id "j/0") get their CMD_EPOCH polls answered from their
+        # OWN job's cache, so one shared relay tier serves every job.
+        self._job_epochs: dict[str, dict] = {}
+        # Relay-side bootstrap-blob cache, per job: (version, bytes) of
+        # the newest CMD_BLOB upload seen.  A same-or-older-version
+        # upload is ACKed LOCALLY (blob_cache_hits) — N children
+        # re-shipping one blob cost the root ONE proxied upload; a
+        # version bump invalidates (replaces) the entry and passes
+        # through.
+        self._blob_cache: dict[str, tuple[int, bytes]] = {}
         # The last batch's replayable sub-messages, held until its ACK
         # lands: a channel cut between send and ACK (a root failover)
         # replays them on the next connect so no check-in, shutdown,
@@ -174,7 +196,8 @@ class Relay:
         # evidence counters
         self.stats = {"children": 0, "rpcs_terminated": 0, "batches": 0,
                       "batch_msgs": 0, "routed": 0, "reconnects": 0,
-                      "failovers": 0, "replayed_msgs": 0}
+                      "failovers": 0, "replayed_msgs": 0,
+                      "blob_cache_hits": 0}
 
     @property
     def tracker(self) -> tuple[str, int]:
@@ -394,10 +417,23 @@ class Relay:
             self._flush_now.set()
             return
         if h.cmd == P.CMD_BLOB:
-            # Proxy straight through: rank-0 blob uploads are large and
-            # rare — the synchronous path keeps them off the envelope.
+            # Blob uploads: the relay caches the newest (job, version)
+            # it has proxied — a re-upload of the same (or an older)
+            # version is ACKed locally so N children re-shipping one
+            # bootstrap blob cost the root ONE fetch; a version bump
+            # invalidates the entry and proxies through (the last
+            # per-call proxy, now amortized — doc/service.md).
+            job, _rest = P.split_job(h.task_id)
+            with self._lock:
+                cached = self._blob_cache.get(job)
+            if cached is not None and h.blob_version <= cached[0]:
+                self.stats["blob_cache_hits"] += 1
+                self.stats["rpcs_terminated"] += 1
+                ch.out += P.put_u32(P.ACK)
+                self._child_flush(sel, children, ch)
+                return
             self._child_detach(sel, children, ch)
-            threading.Thread(target=self._proxy_rpc, args=(ch.sock, h),
+            threading.Thread(target=self._proxy_blob, args=(ch.sock, h, job),
                              daemon=True,
                              name=f"relay-proxy-{self.relay_id}").start()
             return
@@ -419,8 +455,14 @@ class Relay:
                                             h.message.encode(), time.time())
             ch.out += P.put_u32(P.ACK) + self._stamp()
         elif h.cmd == P.CMD_EPOCH:
+            # Per-job cache first (multi-job service, doc/service.md);
+            # the legacy single-job cache serves bare task ids and any
+            # job the ACK map has not named yet.
+            job, _rest = P.split_job(h.task_id)
+            cache = self._job_epochs.get(job) if job else None
             ch.out += (P.put_u32(P.ACK)
-                       + P.put_str(json.dumps(self._epoch_cache)))
+                       + P.put_str(json.dumps(cache if cache is not None
+                                              else self._epoch_cache)))
         elif h.cmd == P.CMD_PRINT:
             with self._lock:
                 self._queued.append(P.BatchMsg(
@@ -456,9 +498,10 @@ class Relay:
             del ch.out[:n]
         self._child_drop(sel, children, ch)
 
-    def _proxy_rpc(self, conn: socket.socket, h: P.Hello) -> None:
+    def _proxy_rpc(self, conn: socket.socket, h: P.Hello) -> bool:
         """Pass one CMD_QUORUM/CMD_BLOB through to the root and relay the
-        reply bytes back verbatim."""
+        reply bytes back verbatim.  Returns True when the root ACKed."""
+        ok = False
         try:
             try:
                 with socket.create_connection(
@@ -471,6 +514,7 @@ class Relay:
                     reply = P.put_u32(ack)
                     if h.cmd == P.CMD_QUORUM:
                         reply += P.put_str(P.get_str(up))
+                ok = True
                 conn.settimeout(self.rpc_timeout)
                 conn.sendall(reply)
             except (ConnectionError, OSError, ValueError):
@@ -480,6 +524,18 @@ class Relay:
                 conn.close()
             except OSError:
                 pass
+        return ok
+
+    def _proxy_blob(self, conn: socket.socket, h: P.Hello,
+                    job: str) -> None:
+        """Proxy one blob upload and — only once the root ACKed — cache
+        it for (job, version): a cache entry must never swallow
+        re-uploads of a blob the root never received."""
+        if self._proxy_rpc(conn, h) and h.blob_version > 0:
+            with self._lock:
+                cached = self._blob_cache.get(job)
+                if cached is None or h.blob_version >= cached[0]:
+                    self._blob_cache[job] = (h.blob_version, h.blob)
 
     def _expire_local_leases(self) -> None:
         """Drop local leases past LEASE_FACTOR x interval: the child is
@@ -608,6 +664,15 @@ class Relay:
             self._epoch_cache = {"epoch": info.get("epoch", 0),
                                  "world": info.get("world", 0),
                                  "rewave": bool(info.get("rewave"))}
+        jobs = info.get("jobs")
+        if isinstance(jobs, dict):
+            # per-job epoch caches from a CollectiveService's ACK; one
+            # whole-map swap keeps reads torn-free without a lock
+            self._job_epochs = {
+                str(k): {"epoch": v.get("epoch", 0),
+                         "world": v.get("world", 0),
+                         "rewave": bool(v.get("rewave"))}
+                for k, v in jobs.items() if isinstance(v, dict)}
         t_recv = time.time()
         t_send = getattr(self, "_last_batch_send", None)
         server_ts = info.get("server_ts")

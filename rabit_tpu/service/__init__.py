@@ -1,0 +1,26 @@
+"""Multi-tenant collective service (doc/service.md).
+
+One long-lived control plane, many concurrent jobs: per-job tracker
+partitions multiplexed on one reactor (:class:`CollectiveService`),
+admission control and per-tenant quotas (:class:`JobRegistry`), every
+job's journal records namespaced into one HA journal
+(:class:`ServiceState`), and warm pooled workers leased to successive
+jobs (:class:`PooledWorker`).
+"""
+
+from rabit_tpu.service.pool import PooledWorker
+from rabit_tpu.service.registry import JobRegistry, tenant_of
+from rabit_tpu.service.service import (
+    AdmissionRefused,
+    CollectiveService,
+)
+from rabit_tpu.service.state import ServiceState
+
+__all__ = [
+    "AdmissionRefused",
+    "CollectiveService",
+    "JobRegistry",
+    "PooledWorker",
+    "ServiceState",
+    "tenant_of",
+]

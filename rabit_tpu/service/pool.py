@@ -1,0 +1,108 @@
+"""Pooled workers — warm processes leased to successive jobs.
+
+A :class:`PooledWorker` is the client half of the service's pool
+(doc/service.md): it parks ONCE per lease cycle with the reserved
+``pool/<name>`` task id (``CMD_SPARE`` — the PR 6 park machinery, so
+the warm socket and the cached-blob path are reused verbatim), waits to
+be leased into whichever job's wave the service fills next, runs that
+job to completion with the ordinary
+:class:`~rabit_tpu.elastic.client.ElasticWorker` loop, and re-parks.
+The process — its Python runtime, its listen socket's port range, its
+heartbeat machinery — stays warm across fits, which is what makes
+thousands of short GBDT fits per minute a service-shaped workload
+instead of thousands of cold worker boots.
+
+The worker never learns job keys: its task id keeps the ``pool/``
+prefix through the whole lease, and the SERVICE routes its RPCs
+(heartbeats, epoch polls, quorum reports, shutdown) to the right
+partition via its lease registry.  Release is an EOF on the park socket
+(the service died or ``stop()`` was called) or the ``max_leases``
+budget running out.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable
+
+import numpy as np
+
+from rabit_tpu.elastic.client import ElasticResult, ElasticWorker
+from rabit_tpu.tracker import protocol as P
+
+
+class PooledWorker:
+    """One pool member (module docstring).
+
+    ``contribution(version, world, rank)`` is the per-round work, shared
+    by every job this worker is leased to (jobs parameterize by world
+    size and rank — the service-bench shape; a real deployment would
+    dispatch on the model the leased job's blob carries).
+    ``max_leases=0`` keeps cycling until the pool is released.
+    """
+
+    def __init__(self, tracker, name: str,
+                 contribution: Callable[[int, int, int], np.ndarray],
+                 niter: int, *,
+                 max_leases: int = 0,
+                 heartbeat_sec: float = 0.0,
+                 deadline_sec: float = 60.0,
+                 rpc_timeout: float = 2.0,
+                 wave_timeout: float = 20.0,
+                 quorum: str = "",
+                 codec: str = ""):
+        self.tracker = tracker
+        self.task_id = P.join_job(P.POOL_PREFIX, name)
+        self.contribution = contribution
+        self.niter = int(niter)
+        self.max_leases = int(max_leases)
+        self.heartbeat_sec = float(heartbeat_sec)
+        self.deadline_sec = float(deadline_sec)
+        self.rpc_timeout = float(rpc_timeout)
+        self.wave_timeout = float(wave_timeout)
+        self.quorum = quorum
+        self.codec = codec
+        self.results: list[ElasticResult] = []
+        self._stop = threading.Event()
+        self._current: ElasticWorker | None = None
+
+    def stop(self) -> None:
+        self._stop.set()
+        cur = self._current
+        if cur is not None:
+            cur.stop()
+
+    def run(self) -> list[ElasticResult]:
+        """Park -> lease -> fit -> re-park until released (EOF/stop) or
+        the lease budget is spent.  Returns one ElasticResult per lease
+        cycle (a final parked-only result marks the release)."""
+        while not self._stop.is_set():
+            worker = ElasticWorker(
+                self.tracker, self.task_id, self.contribution, self.niter,
+                spare=True,
+                heartbeat_sec=self.heartbeat_sec,
+                deadline_sec=self.deadline_sec,
+                rpc_timeout=self.rpc_timeout,
+                wave_timeout=self.wave_timeout,
+                quorum=self.quorum, codec=self.codec)
+            self._current = worker
+            try:
+                res = worker.run()
+            finally:
+                self._current = None
+            self.results.append(res)
+            if res.parked_only or not res.promoted or res.error:
+                break  # released (job over / service gone) or broken
+            if self.max_leases and sum(
+                    1 for r in self.results if r.promoted) \
+                    >= self.max_leases:
+                break
+        return self.results
+
+    def start_thread(self) -> threading.Thread:
+        """Run the lease loop on a daemon thread (the in-process bench/
+        test harness shape)."""
+        t = threading.Thread(target=self.run, daemon=True,
+                             name=f"pooled-{self.task_id}")
+        t.start()
+        return t

@@ -1,0 +1,132 @@
+"""Admission control — which jobs a long-lived service lets in.
+
+A :class:`JobRegistry` is the pure bookkeeping side of multi-tenancy
+(doc/service.md): it validates job keys, derives each job's TENANT (the
+key up to the first ``.`` — ``"teamA.fit17"`` belongs to tenant
+``teamA``), and enforces the quotas that keep one tenant's burst from
+starving its neighbors:
+
+* ``max_jobs`` — concurrent jobs service-wide (0 = unlimited);
+* ``max_jobs_per_tenant`` — concurrent jobs per tenant;
+* ``max_ranks`` — the fd budget: the sum of admitted jobs' world sizes
+  bounds the wave-held sockets + worker links the service can be asked
+  to carry at once (each admitted rank is at least one held connection
+  during its bootstrap wave).
+
+Refusals return a REASON string (never raise): the serving path turns a
+refusal into a structured ``admission_refused`` event and a closed
+connection, and callers that want an exception get it from
+``CollectiveService.admit``.  The registry is deliberately free of
+sockets and clocks so every decision is unit-testable.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+
+from rabit_tpu.tracker import protocol as P
+
+#: Valid job keys: path-safe (the key lands in telemetry filenames),
+#: wire-safe (never contains the JOB_SEP), bounded.
+_KEY_RE = re.compile(r"^[A-Za-z0-9_.\-]{1,64}$")
+
+#: Keys the service itself uses: ``pool`` prefixes service-level pooled
+#: workers, ``service`` names the service's own telemetry file.
+RESERVED_KEYS = frozenset({P.POOL_PREFIX, "service"})
+
+
+def tenant_of(key: str) -> str:
+    """The tenant a job key belongs to (the key up to the first ``.``;
+    the whole key when undotted; ``""`` for the legacy empty key)."""
+    return key.split(".", 1)[0]
+
+
+class JobRegistry:
+    """Thread-safe admission bookkeeping (module docstring)."""
+
+    def __init__(self, max_jobs: int = 0, max_jobs_per_tenant: int = 0,
+                 max_ranks: int = 0):
+        self.max_jobs = int(max_jobs)
+        self.max_jobs_per_tenant = int(max_jobs_per_tenant)
+        self.max_ranks = int(max_ranks)
+        self._lock = threading.Lock()
+        self.jobs: dict[str, int] = {}  # key -> admitted world size
+        self.n_admitted = 0
+        self.n_refused = 0
+        self.n_completed = 0
+
+    @property
+    def ranks_in_use(self) -> int:
+        with self._lock:
+            return sum(self.jobs.values())
+
+    def check(self, key: str, world: int) -> str | None:
+        """Would ``admit`` succeed?  Returns the refusal reason, or None
+        when the job fits.  Does not mutate."""
+        if key != "" and not _KEY_RE.match(key):
+            return f"invalid job key {key!r} (want [A-Za-z0-9_.-], <=64)"
+        if key in RESERVED_KEYS or tenant_of(key) in RESERVED_KEYS:
+            return f"job key {key!r} is reserved"
+        if world < 1:
+            return f"invalid world size {world}"
+        with self._lock:
+            if key in self.jobs:
+                return f"job {key!r} already live"
+            if self.max_jobs > 0 and len(self.jobs) >= self.max_jobs:
+                return (f"service full: {len(self.jobs)}/"
+                        f"{self.max_jobs} jobs live")
+            if self.max_jobs_per_tenant > 0:
+                tenant = tenant_of(key)
+                mine = sum(1 for k in self.jobs if tenant_of(k) == tenant)
+                if mine >= self.max_jobs_per_tenant:
+                    return (f"tenant {tenant!r} full: {mine}/"
+                            f"{self.max_jobs_per_tenant} jobs live")
+            if self.max_ranks > 0 and \
+                    sum(self.jobs.values()) + world > self.max_ranks:
+                return (f"rank budget exceeded: "
+                        f"{sum(self.jobs.values())}+{world} > "
+                        f"{self.max_ranks}")
+        return None
+
+    def admit(self, key: str, world: int,
+              force: bool = False) -> str | None:
+        """Admit a job (atomically re-checking the quotas).  Returns
+        None on success, the refusal reason otherwise.  ``force=True``
+        skips the quota checks (a failover restore must re-admit every
+        journaled live job — they were inside quota when admitted)."""
+        if not force:
+            reason = self.check(key, world)
+            if reason is not None:
+                with self._lock:
+                    self.n_refused += 1
+                return reason
+        with self._lock:
+            if key in self.jobs:
+                return f"job {key!r} already live"
+            self.jobs[key] = max(int(world), 1)
+            self.n_admitted += 1
+        return None
+
+    def release(self, key: str) -> None:
+        """Free a completed/failed job's slot and rank budget."""
+        with self._lock:
+            if self.jobs.pop(key, None) is not None:
+                self.n_completed += 1
+
+    def live(self) -> list[str]:
+        with self._lock:
+            return sorted(self.jobs)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "live_jobs": len(self.jobs),
+                "ranks_in_use": sum(self.jobs.values()),
+                "n_admitted": self.n_admitted,
+                "n_refused": self.n_refused,
+                "n_completed": self.n_completed,
+                "max_jobs": self.max_jobs,
+                "max_jobs_per_tenant": self.max_jobs_per_tenant,
+                "max_ranks": self.max_ranks,
+            }

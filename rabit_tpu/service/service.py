@@ -1,0 +1,520 @@
+"""The multi-tenant collective service — one control plane, many jobs.
+
+A :class:`CollectiveService` turns the tracker stack into a LONG-LIVED
+service (doc/service.md): where a plain
+:class:`~rabit_tpu.tracker.tracker.Tracker` bootstraps one job and dies
+with it, the service keeps serving — each job is a **headless tracker
+partition** (its own ``MembershipManager``/``QuorumTable``/lease table/
+spare pool/telemetry, constructed with ``Tracker(headless=True)``)
+multiplexed on the service's ONE selectors reactor.  The wire does not
+change: a worker of job ``j`` prefixes its task id (``"j/0"``,
+``protocol.join_job``), the service's ``_route_hello`` override splits
+the prefix off and dispatches to the partition, and an empty key routes
+to the legacy ``""`` partition byte-for-byte (the single-job path is
+the unrouted base-class code).
+
+What the service adds on top of the partitions:
+
+* **admission control** (:class:`~rabit_tpu.service.registry.JobRegistry`)
+  — ``admit(key, world)`` checks the job key, the service-wide and
+  per-tenant concurrency quotas, and the rank/fd budget; a refusal is a
+  structured ``admission_refused`` event and — on the wire — a closed
+  connection (the worker's bounded RPC retries fail fast, exactly the
+  dead-tracker shape).  Unknown job keys arriving on the wire are
+  auto-admitted at ``rabit_service_auto_world`` ranks, or refused when
+  that is 0 (the default: programmatic admission only);
+* **one journal, namespaced** — every partition's mutation records ride
+  the service's single :class:`~rabit_tpu.ha.journal.Journal` tagged
+  with their job key (:class:`_JobJournal`), the mirror is a
+  :class:`~rabit_tpu.service.state.ServiceState`, and replay (or a warm
+  standby's takeover, ``Standby(service=True)``) restores EVERY live
+  job from the one file/stream;
+* **a shared relay tier** — relays need no per-job configuration: the
+  job key rides inside the batch route key, and the batch ACK carries a
+  per-job ``jobs`` map so one relay answers every job's CMD_EPOCH polls
+  from its cache (rabit_tpu.relay);
+* **pooled workers** — a worker parked with the reserved ``pool/``
+  prefix (``CMD_SPARE`` — the PR 6 park + cached-blob machinery,
+  unchanged) joins the SERVICE's pool and is leased into successive
+  jobs' waves (``worker_leased``): admit a job with ``pooled=True`` and
+  the service fills its bootstrap wave (and any later recovery wave)
+  from the pool — the "thousands of short GBDT fits per minute" shape
+  where fits reuse warm processes instead of cold-starting workers;
+* **per-job telemetry** — each partition writes
+  ``telemetry-<job>.json`` into the shared obs dir; the service's own
+  serving/admission evidence lands in ``telemetry-service.json``.
+
+Isolation: partitions share nothing but the reactor and the journal's
+writer thread — a straggler storm, worker kill, or quorum stall inside
+one job moves that partition's waves and leases only.  One monitor
+thread pair drives every partition's ``_lease_tick``/``_wave_tick``, so
+N concurrent jobs cost the service two threads, not 2N.
+"""
+
+from __future__ import annotations
+
+import time
+import threading
+
+from rabit_tpu.config import Config
+from rabit_tpu.service.registry import JobRegistry, tenant_of
+from rabit_tpu.service.state import ServiceState
+from rabit_tpu.tracker import protocol as P
+from rabit_tpu.tracker.tracker import Tracker
+
+#: Route-key prefix of one pooled worker: "pool/<name>".
+_POOL_ROUTE = P.POOL_PREFIX + P.JOB_SEP
+
+
+class AdmissionRefused(RuntimeError):
+    """``admit`` hit a quota or an invalid job key (the reason is the
+    message; an ``admission_refused`` event carries it too)."""
+
+
+class _JobJournal:
+    """One partition's view of the service's shared journal: every
+    record the partition appends is tagged with its job key, so one
+    totally-ordered file interleaves every job's history and
+    :class:`~rabit_tpu.service.state.ServiceState` replays each into
+    its own partition (doc/service.md)."""
+
+    def __init__(self, journal, job: str):
+        self._journal = journal
+        self.job = job
+        #: assigned by Tracker.__init__; the service already folds the
+        #: real journal's writer events into its own timeline, so the
+        #: per-partition hook stays unused.
+        self.on_event = None
+
+    def append(self, kind: str, **fields) -> None:
+        self._journal.append(kind, job=self.job, **fields)
+
+    def close(self) -> None:
+        pass  # the service owns the real journal's lifecycle
+
+
+class CollectiveService(Tracker):
+    """One long-lived multi-job tracker (module docstring).
+
+    Constructor shape: the serving/schedule/quorum keywords mirror
+    :class:`Tracker` and become every partition's defaults;
+    ``world_size`` is the legacy ``""`` job's (and ``admit``'s default)
+    world.  Quotas default to the ``rabit_service_*`` config keys
+    (doc/parameters.md).  ``journal`` accepts a path (opened with a
+    multi-job :class:`ServiceState` mirror — an existing file restores
+    every live job) or a ready :class:`~rabit_tpu.ha.journal.Journal`
+    whose state must be a ServiceState; ``resume_from`` is the replayed
+    ServiceState a promoted standby seeds partitions from.
+    """
+
+    def __init__(self, world_size: int = 1, host: str = "127.0.0.1",
+                 port: int = 0,
+                 quiet: bool = False,
+                 obs_dir: str | None = None,
+                 conn_timeout_sec: float = 60.0,
+                 on_suspect=None,
+                 shrink_after_sec: float = 0.0,
+                 min_world: int = 1,
+                 promote_after_sec: float = 0.25,
+                 schedule: str = "auto",
+                 sched_mesh: str = "",
+                 sched_repair: bool = True,
+                 sched_wait_share: float = 0.25,
+                 quorum: str = "",
+                 quorum_flag_after: int = 3,
+                 reactor: bool = True,
+                 backlog: int | None = None,
+                 max_messages: int = 4096,
+                 max_jobs: int | None = None,
+                 max_jobs_per_tenant: int | None = None,
+                 max_ranks: int | None = None,
+                 auto_world: int | None = None,
+                 journal=None,
+                 resume_from: ServiceState | None = None,
+                 listen_sock=None,
+                 ha_tick_sec: float | None = None):
+        cfg = Config()
+        if max_jobs is None:
+            max_jobs = cfg.get_int("rabit_service_max_jobs", 0)
+        if max_jobs_per_tenant is None:
+            max_jobs_per_tenant = cfg.get_int(
+                "rabit_service_max_jobs_per_tenant", 0)
+        if max_ranks is None:
+            max_ranks = cfg.get_int("rabit_service_max_ranks", 0)
+        if auto_world is None:
+            auto_world = cfg.get_int("rabit_service_auto_world", 0)
+        self.registry = JobRegistry(max_jobs=max_jobs,
+                                    max_jobs_per_tenant=max_jobs_per_tenant,
+                                    max_ranks=max_ranks)
+        self.auto_world = int(auto_world)
+        self._default_world = max(int(world_size), 1)
+        # The partition table and pooled-worker lease registry.  A
+        # dedicated lock (never held across a partition call) keeps the
+        # routing hot path free of the base tracker's state lock.
+        self._svc_lock = threading.Lock()
+        self._parts: dict[str, Tracker] = {}
+        self._pooled: set[str] = set()
+        self._admitted_at: dict[str, float] = {}
+        #: full pooled-worker task id -> the job key it is leased to
+        self._pool_leases: dict[str, str] = {}
+        self._part_kwargs = dict(
+            conn_timeout_sec=conn_timeout_sec,
+            shrink_after_sec=shrink_after_sec, min_world=min_world,
+            promote_after_sec=promote_after_sec, schedule=schedule,
+            sched_mesh=sched_mesh, sched_repair=sched_repair,
+            sched_wait_share=sched_wait_share, quorum=quorum,
+            quorum_flag_after=quorum_flag_after,
+            max_messages=max_messages)
+        # The service itself serves (reactor, relay channels, journal
+        # channels) under job="service": its telemetry file is
+        # telemetry-service.json, its journal records are tagged
+        # "service" (dropped by ServiceState — serving evidence, not job
+        # state), and its OWN wave machinery is never fed a worker (the
+        # routing override owns every hello).
+        super().__init__(self._default_world, host=host, port=port,
+                         quiet=quiet, obs_dir=obs_dir,
+                         conn_timeout_sec=conn_timeout_sec,
+                         on_suspect=on_suspect,
+                         schedule=schedule, sched_mesh=sched_mesh,
+                         sched_repair=sched_repair,
+                         sched_wait_share=sched_wait_share,
+                         reactor=reactor, backlog=backlog,
+                         max_messages=max_messages,
+                         journal=None, listen_sock=listen_sock,
+                         ha_tick_sec=ha_tick_sec, job="service")
+        if isinstance(journal, str):
+            from rabit_tpu.ha.journal import Journal
+
+            journal = Journal(
+                journal,
+                state=(resume_from if resume_from is not None
+                       else ServiceState()),
+                seeded=resume_from is not None,
+                snapshot_every=cfg.get_int("rabit_ha_snapshot_every", 256))
+        self.journal = journal
+        if self.journal is not None:
+            self.journal.on_event = self._journal_event
+            if resume_from is None:
+                # an existing file journal replayed at open: restore
+                # every live job it recorded (doc/service.md)
+                resume_from = self.journal.state_snapshot()
+                resume_from = (ServiceState.from_snapshot(resume_from)
+                               if resume_from.get("jobs") or
+                               resume_from.get("service") else None)
+        self._journal("init", base_world=self._default_world)
+        if resume_from is not None:
+            self._restore_jobs(resume_from)
+
+    # -- journal namespacing ------------------------------------------------
+
+    def _journal(self, kind: str, **fields) -> None:
+        """Service-level records are tagged ``job="service"`` (serving
+        evidence — ServiceState drops them); records about a specific
+        job pass their own ``job=`` and keep it."""
+        if self.journal is not None:
+            fields.setdefault("job", "service")
+            self.journal.append(kind, **fields)
+
+    # -- admission ----------------------------------------------------------
+
+    def admit(self, key: str, world: int | None = None, *,
+              pooled: bool = False) -> Tracker:
+        """Admit one job: quota-check, create its partition, journal the
+        admission.  Returns the partition (its ``wait()``/telemetry are
+        the job's); raises :class:`AdmissionRefused` (after emitting the
+        ``admission_refused`` event) when a quota or key check fails.
+
+        ``pooled=True`` marks the job's waves as POOL-FILLED: the
+        service leases parked ``pool/``-workers into every forming wave
+        instead of waiting for the job to bring its own workers."""
+        world = int(world if world is not None else self._default_world)
+        reason = self.registry.admit(key, world)
+        if reason is not None:
+            self._refuse(key, reason)
+            raise AdmissionRefused(reason)
+        part = self._make_partition(key, world, pooled=pooled)
+        self._journal("job_admit", job=key, world=world,
+                      pooled=bool(pooled), tenant=tenant_of(key))
+        with self._lock:
+            self.events.append({
+                "ts": round(time.time(), 6), "kind": "job_admitted",
+                "job": key, "world": world, "pooled": bool(pooled),
+                "tenant": tenant_of(key),
+            })
+        if not self.quiet:
+            print(f"[service] job {key!r} admitted (world {world}"
+                  f"{', pooled' if pooled else ''})", flush=True)
+        return part
+
+    def _refuse(self, key: str, reason: str) -> None:
+        with self._lock:
+            self.events.append({
+                "ts": round(time.time(), 6), "kind": "admission_refused",
+                "job": key, "tenant": tenant_of(key), "reason": reason,
+            })
+        if not self.quiet:
+            print(f"[service] job {key!r} REFUSED: {reason}", flush=True)
+
+    def _wire_admit(self, key: str) -> Tracker | None:
+        """A hello for an unknown job key: auto-admit at
+        ``rabit_service_auto_world`` ranks, else refuse (the connection
+        closes with no reply)."""
+        if self.auto_world <= 0:
+            self._refuse(key, "unknown job (wire auto-admission is off; "
+                              "set rabit_service_auto_world or admit() "
+                              "the job first)")
+            return None
+        try:
+            return self.admit(key, self.auto_world)
+        except AdmissionRefused:
+            return None
+
+    def _make_partition(self, key: str, world: int, pooled: bool = False,
+                        resume=None) -> Tracker:
+        part = Tracker(
+            world, host=self.host, port=self.port, quiet=self.quiet,
+            obs_dir=self.obs_dir,
+            on_suspect=self._suspect_cb(key),
+            reactor=self._reactor,
+            journal=(_JobJournal(self.journal, key)
+                     if self.journal is not None else None),
+            resume_from=resume,
+            job=key, headless=True,
+            **self._part_kwargs)
+        with self._svc_lock:
+            self._parts[key] = part
+            if pooled:
+                self._pooled.add(key)
+            self._admitted_at[key] = time.monotonic()
+        return part
+
+    def _suspect_cb(self, key: str):
+        """Partition lease expiries surface on the service's on_suspect
+        with the FULL wire task id, so one launcher-side callback serves
+        every job."""
+        def cb(task_id: str) -> None:
+            if self.on_suspect is not None:
+                full = (task_id if task_id.startswith(_POOL_ROUTE)
+                        else P.join_job(key, task_id))
+                self.on_suspect(full)
+        return cb
+
+    def _restore_jobs(self, state: ServiceState) -> None:
+        """Re-admit every live job of a replayed ServiceState (a
+        standby's takeover, or an existing journal file reopened): the
+        partitions resume their rank lines, epochs, quorum records and
+        journaled leases exactly as a single-job Tracker resumes from a
+        ControlState (doc/ha.md)."""
+        for key in sorted(state.jobs):
+            cs = state.jobs[key]
+            meta = state.meta.get(key, {})
+            world = int(meta.get("world") or cs.base_world or cs.world or 1)
+            self.registry.admit(key, world, force=True)
+            self._make_partition(key, world,
+                                 pooled=bool(meta.get("pooled")),
+                                 resume=cs)
+            with self._lock:
+                self.events.append({
+                    "ts": round(time.time(), 6), "kind": "job_admitted",
+                    "job": key, "world": world, "tenant": tenant_of(key),
+                    "pooled": bool(meta.get("pooled")), "restored": True,
+                })
+            if not self.quiet:
+                print(f"[service] job {key!r} RESTORED from the journal "
+                      f"(world {world}, epoch {cs.epoch})", flush=True)
+
+    # -- routing (the Tracker seam) -----------------------------------------
+
+    def partition(self, key: str) -> Tracker | None:
+        """The live partition for ``key`` (None once retired)."""
+        with self._svc_lock:
+            return self._parts.get(key)
+
+    def live_jobs(self) -> list[str]:
+        with self._svc_lock:
+            return sorted(self._parts)
+
+    def _route_hello(self, task_id: str, cmd: int):
+        route_id = task_id
+        if route_id.startswith("q#"):
+            # relay-batched quorum reports prefix the child's key
+            # (doc/scaling.md); route on the real id, reply under the
+            # prefixed one (the caller keeps the full route key).
+            route_id = route_id[2:]
+        job, rest = P.split_job(route_id)
+        if job == P.POOL_PREFIX:
+            # A pooled worker: CMD_SPARE (re-)parks it in the SERVICE
+            # pool (releasing any stale lease); every other command
+            # follows its current lease to the job it is working for.
+            if cmd == P.CMD_SPARE:
+                with self._svc_lock:
+                    self._pool_leases.pop(route_id, None)
+                return self, task_id
+            with self._svc_lock:
+                leased = self._pool_leases.get(route_id)
+                part = self._parts.get(leased) if leased is not None \
+                    else None
+            return (part if part is not None else self), task_id
+        if not job:
+            part = self.partition("")
+            if part is not None:
+                return part, task_id
+            # Lazy legacy admission: the first bare-id hello admits the
+            # "" job at the constructor world — the single-job path
+            # through a service, byte-identical to a plain Tracker.
+            try:
+                return self.admit("", self._default_world), task_id
+            except AdmissionRefused:
+                return None, "legacy job refused"
+        part = self.partition(job)
+        if part is None:
+            part = self._wire_admit(job)
+            if part is None:
+                return None, "admission refused"
+        return part, rest
+
+    # -- monitors (one thread pair ticks every partition) -------------------
+
+    def _parts_items(self) -> list[tuple[str, Tracker]]:
+        with self._svc_lock:
+            return sorted(self._parts.items())
+
+    def _lease_tick(self, now: float) -> None:
+        super()._lease_tick(now)
+        for _key, part in self._parts_items():
+            part._lease_tick(now)
+
+    def _wave_tick(self) -> None:
+        with self._lock:
+            # dead pooled workers must leave the pool before a lease
+            # hands a job a dead socket (the spare-reap contract)
+            self._reap_spares_locked()
+        for key, part in self._parts_items():
+            if part._done.is_set():
+                self._retire(key, part)
+                continue
+            with self._svc_lock:
+                pooled = key in self._pooled
+            if pooled:
+                self._fill_from_pool(key, part)
+            part._wave_tick()
+
+    def _fill_from_pool(self, key: str, part: Tracker) -> None:
+        """Lease parked ``pool/`` workers into a pooled job's forming
+        wave: the bootstrap wave of a fresh job (no epoch yet) and any
+        later recovery wave (survivors pending) fill to the job's world
+        from the service pool; each lease is a ``worker_leased`` event
+        and a lease-registry entry that routes the worker's RPCs to this
+        partition until it re-parks or the job completes."""
+        with part._lock:
+            if part._done.is_set():
+                return
+            need = part.world_size - len(part._pending)
+            fresh = part.elastic.epoch < 0
+            forming = bool(part._pending)
+        if need <= 0 or not (fresh or forming):
+            return
+        take = []
+        with self._lock:
+            avail = [s for s in self._spares
+                     if s.task_id.startswith(_POOL_ROUTE)]
+            take = avail[:need]
+            if not take:
+                return
+            taken = set(map(id, take))
+            self._spares = [s for s in self._spares
+                            if id(s) not in taken]
+        with self._svc_lock:
+            for s in take:
+                self._pool_leases[s.task_id] = key
+        ts = round(time.time(), 6)
+        with self._lock:
+            pool_left = sum(1 for s in self._spares
+                            if s.task_id.startswith(_POOL_ROUTE))
+            for s in take:
+                self.events.append({
+                    "ts": ts, "kind": "worker_leased",
+                    "task_id": s.task_id, "job": key, "pool": pool_left,
+                })
+        if not self.quiet:
+            print(f"[service] leased {[s.task_id for s in take]} -> "
+                  f"job {key!r} (pool {pool_left})", flush=True)
+        with part._lock:
+            for s in take:
+                s.cmd = P.CMD_START
+                s.origin = "spare"
+                part._pending.append(s)
+                part._pending_ids.add(s.task_id)
+            if part._wave_started is None:
+                part._wave_started = time.monotonic()
+            plan = part._close_wave_locked(timer=False)
+        if plan is not None:
+            part._send_wave(plan)
+
+    def _retire(self, key: str, part: Tracker) -> None:
+        """A completed job leaves the service: its quota slot and rank
+        budget free up, its pooled workers' leases clear (they re-park
+        on their own), and a ``job_retired`` record removes it from the
+        journal's live set — replay after this point restores every
+        OTHER job."""
+        with self._svc_lock:
+            if self._parts.get(key) is not part:
+                return  # already retired by a concurrent tick
+            self._parts.pop(key)
+            self._pooled.discard(key)
+            for tid in [t for t, j in self._pool_leases.items()
+                        if j == key]:
+                self._pool_leases.pop(tid)
+            admitted_at = self._admitted_at.pop(key, None)
+        part.stop()  # idempotent telemetry flush + spare release
+        self.registry.release(key)
+        self._journal("job_retired", job=key)
+        with self._lock:
+            self.events.append({
+                "ts": round(time.time(), 6), "kind": "job_completed",
+                "job": key, "world": part.world_size,
+                "seconds": (round(time.monotonic() - admitted_at, 6)
+                            if admitted_at is not None else -1.0),
+            })
+        if not self.quiet:
+            print(f"[service] job {key!r} completed "
+                  f"({self.registry.stats()['live_jobs']} live)",
+                  flush=True)
+
+    # -- relay fan-out -------------------------------------------------------
+
+    def _batch_ack_info(self) -> dict:
+        """The shared relay tier's cache refresh: the base fields plus a
+        per-job ``jobs`` map, so one relay answers CMD_EPOCH locally for
+        every job behind it (doc/service.md)."""
+        info = super()._batch_ack_info()
+        info["jobs"] = {key: part._epoch_info()
+                        for key, part in self._parts_items()}
+        return info
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def stop(self) -> None:
+        for _key, part in self._parts_items():
+            part.stop()
+        super().stop()
+
+    def kill(self) -> None:
+        for _key, part in self._parts_items():
+            part.kill()
+        super().kill()
+
+    def build_telemetry(self) -> dict:
+        doc = super().build_telemetry()
+        with self._lock:
+            pool = sum(1 for s in self._spares
+                       if s.task_id.startswith(_POOL_ROUTE))
+        doc["service"] = {
+            **self.registry.stats(),
+            "live": self.live_jobs(),
+            "pool_parked": pool,
+            "auto_world": self.auto_world,
+            "n_leased": sum(1 for e in doc["events"]
+                            if e["kind"] == "worker_leased"),
+        }
+        return doc

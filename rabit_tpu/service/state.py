@@ -1,0 +1,151 @@
+"""The multi-job control plane as one replayable state machine.
+
+A :class:`ServiceState` is the service-level analog of
+:class:`rabit_tpu.ha.state.ControlState`: where the single-job state is
+mutated by journal records, the service state is mutated by the SAME
+records carrying one extra ``job`` field — the key of the partition the
+record belongs to.  One ``rabit_ha_journal`` file (or CMD_JOURNAL
+stream) therefore holds every live job's history interleaved in commit
+order, and replaying it restores every partition (doc/service.md,
+doc/ha.md).
+
+Routing rules, chosen so a journal remains evidence under every mix of
+writers:
+
+* a record's ``job`` field (default ``""``) selects the partition; the
+  per-partition fold is EXACTLY ``ControlState.apply`` — the replay
+  determinism the single-job gate proves carries over per job;
+* a partition comes into existence only through its ``init`` record or
+  a service-level ``job_admit`` record — stray records of never-admitted
+  jobs (and the journal's untagged ``tick`` keepalives) are dropped, so
+  liveness noise can never materialize a phantom job;
+* ``job_retired`` removes a completed job's partition — "replay restores
+  every live job" means exactly the jobs that were admitted and have not
+  completed;
+* a ``snapshot`` record holding a service-format state (the ``service``
+  marker key) replaces everything — the compaction head; a single-job
+  snapshot record routes into its partition like any other record, so a
+  pre-service journal replays into the legacy ``""`` partition.
+
+``snapshot_bytes`` stays canonical (sorted keys, no whitespace), so
+"standby replay == primary mirror" remains one byte comparison with any
+number of jobs interleaved — the property gate tests/test_ha.py extends
+to two interleaved jobs.
+"""
+
+from __future__ import annotations
+
+import json
+
+from rabit_tpu.ha.state import ControlState
+
+#: Record kinds that may CREATE a partition (see module docstring).
+_CREATE_KINDS = ("init", "job_admit")
+
+
+class ServiceState:
+    """Every live job's :class:`ControlState`, plus the service-level
+    admission metadata a promoted tracker re-admits partitions from."""
+
+    def __init__(self) -> None:
+        self.jobs: dict[str, ControlState] = {}
+        #: per-job admission metadata (``job_admit`` records):
+        #: {"world": W, "pooled": bool, "tenant": str}
+        self.meta: dict[str, dict] = {}
+        self.applied = 0  # records folded in (diagnostics only)
+
+    def job(self, key: str) -> ControlState:
+        """The partition for ``key``, created empty when absent."""
+        return self.jobs.setdefault(str(key), ControlState())
+
+    # -- record application -------------------------------------------------
+
+    def apply(self, kind: str, fields: dict) -> None:
+        """Fold one journal record in (module docstring routing rules).
+        Deterministic and tolerant: malformed fields drop the record,
+        never poison the replay."""
+        fields = dict(fields or {})
+        try:
+            key = str(fields.pop("job", ""))
+        except (TypeError, ValueError):
+            return
+        if key == "service":
+            # the service's own serving evidence (init, ticks, pool
+            # parks) — never job state; reserved by the registry so no
+            # real job can collide with it
+            return
+        if kind == "snapshot":
+            state = fields.get("state")
+            if isinstance(state, dict) and state.get("service"):
+                self.load_snapshot(state)
+            else:
+                # a single-job snapshot record: one partition's history
+                # (a pre-service journal) replays into its partition
+                self.job(key).apply(kind, fields)
+            self.applied += 1
+            return
+        if kind == "job_admit":
+            try:
+                world = int(fields.get("world", 0))
+            except (TypeError, ValueError):
+                return
+            self.meta[key] = {"world": world,
+                              "pooled": bool(fields.get("pooled")),
+                              "tenant": str(fields.get("tenant", ""))}
+            self.job(key)
+            self.applied += 1
+            return
+        if kind == "job_retired":
+            self.jobs.pop(key, None)
+            self.meta.pop(key, None)
+            self.applied += 1
+            return
+        if key not in self.jobs and kind not in _CREATE_KINDS:
+            return  # tick keepalives / records of never-admitted jobs
+        self.job(key).apply(kind, fields)
+        self.applied += 1
+
+    # -- snapshots ----------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        return {
+            "service": 1,
+            "jobs": {k: cs.snapshot() for k, cs in sorted(self.jobs.items())},
+            "meta": {k: dict(m) for k, m in sorted(self.meta.items())},
+        }
+
+    def snapshot_bytes(self) -> bytes:
+        """CANONICAL byte encoding (sorted keys, no whitespace) — the
+        multi-job replay-determinism byte compare."""
+        return json.dumps(self.snapshot(), sort_keys=True,
+                          separators=(",", ":")).encode()
+
+    def load_snapshot(self, snap: dict) -> None:
+        self.jobs = {str(k): ControlState.from_snapshot(s)
+                     for k, s in (snap.get("jobs") or {}).items()}
+        self.meta = {str(k): dict(m)
+                     for k, m in (snap.get("meta") or {}).items()}
+
+    @classmethod
+    def from_snapshot(cls, snap: dict) -> "ServiceState":
+        state = cls()
+        state.load_snapshot(snap)
+        return state
+
+    # -- aggregate views (standby logging, telemetry) -----------------------
+
+    @property
+    def n_jobs(self) -> int:
+        return len(self.jobs)
+
+    @property
+    def epoch(self) -> int:
+        """The legacy partition's epoch (-1 when no ``""`` job lives) —
+        keeps the standby's sync/failover log lines meaningful."""
+        cs = self.jobs.get("")
+        return cs.epoch if cs is not None else -1
+
+    @property
+    def world(self) -> int:
+        cs = self.jobs.get("")
+        return cs.world if cs is not None else 0

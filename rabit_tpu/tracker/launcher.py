@@ -80,6 +80,7 @@ class LocalCluster:
         standby: bool = False,
         ha_journal: str = "",
         takeover_sec: float = 1.0,
+        job: str = "",
     ):
         self.num_workers = num_workers
         self.max_restarts = max_restarts
@@ -107,6 +108,11 @@ class LocalCluster:
         self.use_standby = bool(standby)
         self.ha_journal = str(ha_journal or "")
         self.takeover_sec = float(takeover_sec)
+        #: multi-tenant job key (doc/service.md): exported to the
+        #: workers as rabit_job_key so they prefix their wire task ids
+        #: — point the cluster at a CollectiveService and the whole run
+        #: becomes one tenant of it.  Empty = legacy ids, byte-identical.
+        self.job = str(job)
         self.standby = None
         self._worker_addrs: list[tuple[str, int]] = []
         #: per-task restart / last-returncode bookkeeping, keyed by TASK ID
@@ -184,6 +190,8 @@ class LocalCluster:
             # over defaults, so the worker sees rabit_spare=1 without
             # touching its argv.
             env["RABIT_TPU_RABIT_SPARE"] = "1"
+        if self.job:
+            env["RABIT_TPU_RABIT_JOB_KEY"] = self.job
         if self._worker_addrs and not self.relays:
             # The HA failover list (doc/ha.md): direct workers rotate
             # through primary-then-standby; relayed workers keep their
@@ -495,6 +503,13 @@ def main(argv: list[str] | None = None) -> int:
              "rabit_ha_takeover_sec config key)",
     )
     ap.add_argument(
+        "--job", default="", metavar="KEY",
+        help="multi-tenant job key (rabit_job_key; doc/service.md): "
+             "workers prefix their task ids with KEY/ so a "
+             "CollectiveService routes them to this job's partition "
+             "(default: the rabit_job_key config key)",
+    )
+    ap.add_argument(
         "--kill-tracker-after", type=float, default=None, metavar="SEC",
         help="ABRUPTLY kill the primary tracker SEC seconds in (the "
              "in-process SIGKILL; pair with --standby to prove the "
@@ -550,7 +565,9 @@ def main(argv: list[str] | None = None) -> int:
                            relays=args.relays,
                            standby=args.standby,
                            ha_journal=ha_journal,
-                           takeover_sec=takeover)
+                           takeover_sec=takeover,
+                           job=args.job or cfg.get("rabit_job_key", "")
+                           or "")
     return cluster.run(cmd, timeout=args.timeout, preempt=preempt,
                        wedge=wedge,
                        kill_tracker_after=args.kill_tracker_after)

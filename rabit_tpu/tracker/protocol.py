@@ -147,6 +147,23 @@ standby <-> tracker journal channel (doc/ha.md): a warm-standby tracker
     ``rabit_ha_journal`` file holds, so file tailing and channel
     streaming replay identically (rabit_tpu/ha/journal.py).
 
+multi-tenant job keys (rabit_tpu.service, doc/service.md): a worker of a
+    named job prefixes its wire task id with the job key —
+    ``"<job>/<task>"`` (:func:`join_job` / :func:`split_job`).  The key
+    rides INSIDE the existing task-id field, so the hello's byte layout
+    is untouched: an empty job key produces byte-for-byte the legacy
+    single-job hello (asserted by tests/test_service.py), the native C++
+    client needs no change (its task id is an opaque string), and every
+    reply — assignments, park frames, routed relay frames — already
+    routes by the full task id.  A multi-job tracker
+    (``rabit_tpu.service.CollectiveService``) splits the prefix off and
+    dispatches to the job's control-plane partition; the plain Tracker
+    treats the whole string as the task id, exactly as before.  The
+    reserved prefix ``pool/`` marks service-level pooled workers
+    (leased across jobs); job keys are validated against
+    ``[A-Za-z0-9_.-]`` at admission so a key can never alias a path or
+    another job's records.
+
 worker <-> worker link handshake (both directions on connect/accept):
     u32 MAGIC_LINK, i32 my_rank, u32 epoch
 
@@ -210,6 +227,30 @@ LEASE_FACTOR = 2.0
 
 _U32 = struct.Struct("<I")
 _I32 = struct.Struct("<i")
+
+#: Separator of the optional multi-tenant job key inside the wire task id
+#: (doc/service.md).  The key is a PREFIX of the existing string field —
+#: not a new wire field — so an empty key is byte-identical to the
+#: legacy single-job hello.
+JOB_SEP = "/"
+
+#: Reserved task-id prefix of service-level pooled workers (parked once,
+#: leased to successive jobs; rabit_tpu.service.PooledWorker).  Never a
+#: valid job key.
+POOL_PREFIX = "pool"
+
+
+def join_job(job: str, task_id: str) -> str:
+    """The wire task id of ``task_id`` under job ``job`` ("" = the
+    legacy single-job namespace: returns ``task_id`` unchanged)."""
+    return f"{job}{JOB_SEP}{task_id}" if job else task_id
+
+
+def split_job(task_id: str) -> tuple[str, str]:
+    """Split one wire task id into ``(job_key, local_task_id)`` —
+    ``("", task_id)`` when it carries no job prefix."""
+    job, sep, rest = task_id.partition(JOB_SEP)
+    return (job, rest) if sep else ("", task_id)
 
 
 def send_all(sock: socket.socket, data: bytes) -> None:
@@ -368,7 +409,11 @@ def send_hello(
     message: str = "",
     blob: bytes = b"",
     blob_version: int = 0,
+    job: str = "",
 ) -> None:
+    # The optional job key is a task-id prefix, never a new field: an
+    # empty key writes byte-for-byte the legacy hello (doc/service.md).
+    task_id = join_job(job, task_id)
     out = [put_u32(MAGIC_HELLO), put_u32(cmd), put_i32(prev_rank), put_str(task_id)]
     if cmd in (CMD_START, CMD_RECOVER, CMD_SPARE):
         out.append(put_u32(listen_port))
@@ -775,6 +820,7 @@ def tracker_rpc(
     backoff_cap: float = 2.0,
     rng: random.Random | None = None,
     addrs: "list[tuple[str, int]] | None" = None,
+    job: str = "",
 ) -> "Assignment | int":
     """The one resilient client path for every Python-side tracker message
     (bootstrap check-ins, print, metrics, heartbeat, shutdown).
@@ -810,6 +856,7 @@ def tracker_rpc(
     candidate; duplicates are dropped.
     """
     rng = rng if rng is not None else random
+    task_id = join_job(job, task_id)
     retries = max(int(retries), 0)
     cands = [(host, int(port))]
     for a in addrs or []:

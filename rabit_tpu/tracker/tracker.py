@@ -69,6 +69,15 @@ assignments, park frames) are routed back by task id — so a world of N
 workers behind R relays costs the root tracker O(R) connections, not
 O(N), for bootstrap and liveness alike.
 
+Multi-tenant service (rabit_tpu.service, doc/service.md): every worker
+hello is mapped through ONE routing seam (``_route_hello``) to the
+tracker that owns it — the base class maps every id to itself, so plain
+single-job serving is byte-for-byte unrouted.  ``headless=True`` builds
+a job PARTITION (no listen socket, no threads): a CollectiveService
+multiplexes many such partitions on its one reactor, drives their
+``_lease_tick``/``_wave_tick`` from one monitor pair, and namespaces
+their journal records and telemetry files by job key.
+
 Collective schedules (doc/scheduling.md): every wave is planned by
 ``rabit_tpu.sched`` — ``rabit_schedule=auto|tree|ring|swing`` picks the
 ring layout over the mesh model, and worker ``slow_link`` reports
@@ -354,7 +363,9 @@ class Tracker:
                  journal=None,
                  resume_from=None,
                  listen_sock: socket.socket | None = None,
-                 ha_tick_sec: float | None = None):
+                 ha_tick_sec: float | None = None,
+                 job: str = "",
+                 headless: bool = False):
         #: CURRENT world size — mutable under elastic membership (shrink/
         #: grow); ``base_world`` is the launch size and grow-back target.
         self.world_size = world_size
@@ -434,7 +445,20 @@ class Tracker:
         if backlog is None:
             backlog = Config().get_int("rabit_tracker_backlog", 1024)
         self.backlog = max(int(backlog), 1)
-        if listen_sock is not None:
+        # Multi-tenant service seams (rabit_tpu.service, doc/service.md):
+        # ``job`` names this tracker's control-plane partition (it tags
+        # the telemetry filename — telemetry-<job>.json — and every
+        # journal record the service wraps); ``headless=True`` builds a
+        # PARTITION: no listen socket, no serving threads — a
+        # CollectiveService owns the one reactor and feeds this
+        # partition parsed hellos, and its monitor loop drives
+        # _lease_tick/_wave_tick.
+        self.job = str(job)
+        self.headless = bool(headless)
+        if headless:
+            self._srv = None
+            self.host, self.port = host, int(port)
+        elif listen_sock is not None:
             # HA takeover (rabit_tpu.ha.Standby): the standby pre-bound
             # its advertised address; listen() here is the moment it
             # starts answering the client-side failover rotation.
@@ -445,7 +469,8 @@ class Tracker:
             self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
             self._srv.bind((host, port))
             self._srv.listen(self.backlog)
-        self.host, self.port = self._srv.getsockname()
+        if self._srv is not None:
+            self.host, self.port = self._srv.getsockname()
         self._lock = threading.Lock()
         self._pending: list[_Pending] = []
         self._pending_ids: set[str] = set()  # O(1) re-check-in detection
@@ -559,6 +584,10 @@ class Tracker:
     # -- lifecycle ---------------------------------------------------------
 
     def start(self) -> "Tracker":
+        if self.headless:
+            raise RuntimeError(
+                "a headless partition has no serving loop — its owning "
+                "CollectiveService serves and ticks it (doc/service.md)")
         serve = self._serve_reactor if self._reactor else self._serve
         self._thread = threading.Thread(target=serve, daemon=True,
                                         name="rabit-tracker-serve")
@@ -579,14 +608,15 @@ class Tracker:
         # fd alive for the in-flight call), leaving a "stopped" tracker
         # listening — and serving — indefinitely.  shutdown() wakes the
         # accept with an error immediately.
-        try:
-            self._srv.shutdown(socket.SHUT_RDWR)
-        except OSError:
-            pass
-        try:
-            self._srv.close()
-        except OSError:
-            pass
+        if self._srv is not None:
+            try:
+                self._srv.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                self._srv.close()
+            except OSError:
+                pass
         with self._lock:
             channels, self._relay_channels = self._relay_channels, []
             jconns, self._journal_conns = self._journal_conns, []
@@ -617,14 +647,15 @@ class Tracker:
         with self._lock:
             self._telemetry_written = True  # a SIGKILL leaves no gasp
         self._done.set()
-        try:
-            self._srv.shutdown(socket.SHUT_RDWR)
-        except OSError:
-            pass
-        try:
-            self._srv.close()
-        except OSError:
-            pass
+        if self._srv is not None:
+            try:
+                self._srv.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                self._srv.close()
+            except OSError:
+                pass
         with self._lock:
             channels, self._relay_channels = self._relay_channels, []
             jconns, self._journal_conns = self._journal_conns, []
@@ -706,20 +737,28 @@ class Tracker:
                 # WAITS (held open until the wave completer answers it), so
                 # the read deadline comes off again.
                 conn.settimeout(None)
-                with self._lock:
+                tr, tid = self._route_hello(task_id, cmd)
+                if tr is None:
+                    conn.close()  # admission refused (doc/service.md)
+                    return
+                with tr._lock:
                     # A (re-)check-in supersedes any lease of the previous
                     # life: the fresh worker renews once it is back up, and
                     # a stale lease must not re-suspect it mid-bootstrap.
-                    self._drop_lease_locked(task_id)
-                self._register(conn, addr[0], task_id, listen_port, prev_rank,
-                               cmd)
+                    tr._drop_lease_locked(tid)
+                tr._register(conn, addr[0], tid, listen_port, prev_rank,
+                             cmd)
                 # conn is answered (and closed) by the wave completer.
                 return
             if cmd == P.CMD_SPARE:
                 listen_port = P.get_u32(conn)
                 conn.settimeout(None)
-                self._park_spare(conn, addr[0], task_id, listen_port,
-                                 prev_rank)
+                tr, tid = self._route_hello(task_id, cmd)
+                if tr is None:
+                    conn.close()
+                    return
+                tr._park_spare(conn, addr[0], tid, listen_port,
+                               prev_rank)
                 # conn stays open (the warm socket); promotion answers it.
                 return
             if cmd == P.CMD_BATCH:
@@ -741,7 +780,12 @@ class Tracker:
                 hello.blob = P.recv_exact(conn, nbytes) if nbytes else b""
             elif cmd != P.CMD_SHUTDOWN:
                 hello.message = P.get_str(conn)
-            reply, post = self._short_rpc_reply(hello)
+            tr, tid = self._route_hello(task_id, cmd)
+            if tr is None:
+                conn.close()
+                return
+            hello.task_id = tid
+            reply, post = tr._short_rpc_reply(hello)
             conn.sendall(reply)
             if post is not None:
                 post()
@@ -766,17 +810,8 @@ class Tracker:
             # rides as the payload (informational); the reply carries
             # the current epoch and the rewave flag that triggers the
             # grow-back wave (doc/elasticity.md).
-            with self._lock:
-                self._reap_spares_locked()
-                # rewave on grow-back AND on a pending schedule
-                # repair: both resolve at the same version-boundary
-                # wave (doc/scheduling.md, "Repair policy").
-                info = {"epoch": self.elastic.epoch,
-                        "world": self.world_size,
-                        "rewave": (self.elastic.grow_wanted(
-                            len(self._spares))
-                            or self._repair_wanted)}
-            return P.put_u32(P.ACK) + P.put_str(json.dumps(info)), None
+            return (P.put_u32(P.ACK)
+                    + P.put_str(json.dumps(self._epoch_info()))), None
         if h.cmd == P.CMD_BLOB:
             with self._lock:
                 if self._blob is None or h.blob_version >= self._blob[0]:
@@ -811,6 +846,28 @@ class Tracker:
                 self._drop_lease_locked(h.task_id)
             return P.put_u32(P.ACK), lambda: self._note_shutdown(h.task_id)
         raise ValueError(f"unknown tracker cmd {h.cmd}")
+
+    def _epoch_info(self) -> dict:
+        """The CMD_EPOCH reply document — current epoch/world plus the
+        rewave flag (grow-back AND pending schedule repair resolve at
+        the same version-boundary wave; doc/scheduling.md)."""
+        with self._lock:
+            self._reap_spares_locked()
+            return {"epoch": self.elastic.epoch,
+                    "world": self.world_size,
+                    "rewave": (self.elastic.grow_wanted(len(self._spares))
+                               or self._repair_wanted)}
+
+    def _route_hello(self, task_id: str,
+                     cmd: int) -> "tuple[Tracker | None, str]":
+        """The multiplexing seam (rabit_tpu.service, doc/service.md):
+        map one worker hello to ``(owner tracker, owner-local task id)``.
+        The base tracker owns every id verbatim — single-job serving is
+        byte-for-byte unrouted.  A CollectiveService overrides this to
+        split the job-key prefix off and dispatch to the job's headless
+        partition; ``(None, reason)`` refuses the hello (the connection
+        closes with no reply — admission control's shape on the wire)."""
+        return self, task_id
 
     def _note_shutdown(self, task_id: str) -> None:
         """Post-ACK shutdown bookkeeping (the completion guard)."""
@@ -983,21 +1040,29 @@ class Tracker:
             return
         try:
             if h.cmd in (P.CMD_START, P.CMD_RECOVER):
+                tr, tid = self._route_hello(h.task_id, h.cmd)
+                if tr is None:
+                    self._reactor_drop(sel, conns, rc)
+                    return
                 self._reactor_detach(sel, conns, rc)
-                with self._lock:
-                    self._drop_lease_locked(h.task_id)
-                self._register(rc.sock, rc.addr[0], h.task_id,
-                               h.listen_port, h.prev_rank, h.cmd,
-                               async_send=True)
+                with tr._lock:
+                    tr._drop_lease_locked(tid)
+                tr._register(rc.sock, rc.addr[0], tid,
+                             h.listen_port, h.prev_rank, h.cmd,
+                             async_send=True)
                 return
             if h.cmd == P.CMD_SPARE:
                 # Park replies ship the cached blob (possibly large):
                 # spares are rare, wave-held sockets — a thread each is
                 # the design, not a regression.
+                tr, tid = self._route_hello(h.task_id, h.cmd)
+                if tr is None:
+                    self._reactor_drop(sel, conns, rc)
+                    return
                 self._reactor_detach(sel, conns, rc)
                 threading.Thread(
-                    target=self._park_spare,
-                    args=(rc.sock, rc.addr[0], h.task_id, h.listen_port,
+                    target=tr._park_spare,
+                    args=(rc.sock, rc.addr[0], tid, h.listen_port,
                           h.prev_rank),
                     daemon=True, name="rabit-tracker-park").start()
                 return
@@ -1018,7 +1083,12 @@ class Tracker:
                     daemon=True,
                     name=f"rabit-ha-tx-{h.task_id}").start()
                 return
-            reply, post = self._short_rpc_reply(h)
+            tr, tid = self._route_hello(h.task_id, h.cmd)
+            if tr is None:
+                self._reactor_drop(sel, conns, rc)
+                return
+            h.task_id = tid
+            reply, post = tr._short_rpc_reply(h)
         except (ValueError, OSError):
             self._reactor_drop(sel, conns, rc)
             return
@@ -1138,16 +1208,10 @@ class Tracker:
                 with self._stats_lock:
                     self.serve_stats["batches"] += 1
                     self.serve_stats["batch_msgs"] += len(msgs)
-                with self._lock:
-                    self._reap_spares_locked()
-                    info = {"server_ts": round(time.time(), 6),
-                            "acks": acks,
-                            "epoch": self.elastic.epoch,
-                            "world": self.world_size,
-                            "rewave": (self.elastic.grow_wanted(
-                                len(self._spares))
-                                or self._repair_wanted)}
-                    if msgs:  # empty keepalives refresh caches silently
+                info = self._batch_ack_info()
+                info["acks"] = acks
+                if msgs:  # empty keepalives refresh caches silently
+                    with self._lock:
                         self.events.append({
                             "ts": info["server_ts"], "kind": "batch_folded",
                             "relay": relay_id, "n": len(msgs),
@@ -1168,32 +1232,50 @@ class Tracker:
                 print(f"[tracker] relay {relay_id} channel lost "
                       f"(stateless fan-in: children reconnect)", flush=True)
 
+    def _batch_ack_info(self) -> dict:
+        """The batch-ACK document a relay refreshes its caches from:
+        clock stamp plus the current epoch/world/rewave.  A
+        CollectiveService adds a per-job ``jobs`` map so one shared
+        relay tier can answer every job's CMD_EPOCH polls locally
+        (doc/service.md)."""
+        info = {"server_ts": round(time.time(), 6)}
+        info.update(self._epoch_info())
+        return info
+
     def _fold_batch_msg(self, channel: _RelayChannel,
                         m: P.BatchMsg) -> float:
         """Fold one relayed sub-message; returns the tracker-clock ingest
         stamp for the batch ACK's per-child acks list."""
         ts = round(time.time(), 6)
         try:
+            # The route key stays the FULL wire task id (job prefix
+            # included) — that is what the relay parked the child under;
+            # the owning partition sees its local id (doc/service.md).
+            tr, tid = self._route_hello(m.task_id, m.cmd)
+            if tr is None:
+                if m.cmd != P.CMD_HANGUP:
+                    return ts  # admission refused; the child's RPC times out
+                tr, tid = self, m.task_id
             if m.cmd in (P.CMD_START, P.CMD_RECOVER):
                 vconn = _RelayedConn(channel, m.task_id)
-                with self._lock:
-                    self._drop_lease_locked(m.task_id)
-                self._register(vconn, m.host, m.task_id, m.listen_port,
-                               m.prev_rank, m.cmd, async_send=True)
+                with tr._lock:
+                    tr._drop_lease_locked(tid)
+                tr._register(vconn, m.host, tid, m.listen_port,
+                             m.prev_rank, m.cmd, async_send=True)
             elif m.cmd == P.CMD_SPARE:
-                self._park_spare(_RelayedConn(channel, m.task_id), m.host,
-                                 m.task_id, m.listen_port, m.prev_rank)
+                tr._park_spare(_RelayedConn(channel, m.task_id), m.host,
+                               tid, m.listen_port, m.prev_rank)
             elif m.cmd == P.CMD_HEARTBEAT:
-                self._renew_lease(m.task_id, m.prev_rank,
-                                  m.payload.decode())
+                tr._renew_lease(tid, m.prev_rank,
+                                m.payload.decode())
             elif m.cmd == P.CMD_METRICS:
-                self._accept_snapshot(m.payload.decode())
+                tr._accept_snapshot(m.payload.decode())
             elif m.cmd == P.CMD_PRINT:
-                self._log_print(m.payload.decode())
+                tr._log_print(m.payload.decode())
             elif m.cmd == P.CMD_SHUTDOWN:
-                with self._lock:
-                    self._drop_lease_locked(m.task_id)
-                self._note_shutdown(m.task_id)
+                with tr._lock:
+                    tr._drop_lease_locked(tid)
+                tr._note_shutdown(tid)
             elif m.cmd == P.CMD_QUORUM:
                 # A quorum-round report folded through the batch
                 # envelope (the PR 9 follow-on: a quorum-heavy world no
@@ -1203,7 +1285,7 @@ class Tracker:
                 # the reply bytes are exactly the direct path's
                 # (ACK + record JSON), and re-delivery after a channel
                 # cut is safe because the table decides once.
-                reply = self._quorum_report(m.payload.decode())
+                reply = tr._quorum_report(m.payload.decode())
                 channel.send_route(
                     m.task_id, P.ROUTE_CLOSE,
                     P.put_u32(P.ACK) + P.put_str(json.dumps(reply)))
@@ -1583,10 +1665,16 @@ class Tracker:
         the pending wave and close it when the membership manager says so.
         A no-op for non-elastic jobs (no spares, shrinking disabled)."""
         while not self._done.wait(0.05):
-            with self._lock:
-                plan = self._close_wave_locked(timer=True)
-            if plan is not None:
-                self._send_wave(plan)
+            self._wave_tick()
+
+    def _wave_tick(self) -> None:
+        """One wave-monitor scan — factored out so a CollectiveService's
+        single monitor thread can tick every headless partition
+        (doc/service.md) instead of running a thread pair per job."""
+        with self._lock:
+            plan = self._close_wave_locked(timer=True)
+        if plan is not None:
+            self._send_wave(plan)
 
     def note_dead(self, task_id: str) -> None:
         """Fast-path promotion hook: a task known dead (lease expired,
@@ -1747,49 +1835,56 @@ class Tracker:
             if self.journal is not None and now >= next_tick:
                 # The HA keepalive: a tick record proves the primary is
                 # alive to file-tailing AND streaming standbys, so an
-                # idle job never looks dead (doc/ha.md).
+                # idle job never looks dead (doc/ha.md).  Ticks stay in
+                # the serving tracker's loop — headless partitions share
+                # their service's journal, which ticks once for all.
                 next_tick = now + self._ha_tick_sec
                 self._journal("tick")
-            expired: list[tuple[str, _Lease]] = []
-            with self._lock:
-                for task_id, lease in list(self._leases.items()):
-                    if now >= lease.expires:
-                        del self._leases[task_id]
-                        self._journal("lease_drop", task_id=task_id)
-                        expired.append((task_id, lease))
-                for task_id, lease in expired:
-                    self.events.append({
-                        "ts": round(time.time(), 6), "kind": "lease_expired",
-                        "task_id": task_id, "rank": lease.rank,
-                        "interval": lease.interval,
-                        "overdue": round(now - lease.expires, 6),
-                    })
+            self._lease_tick(now)
+
+    def _lease_tick(self, now: float) -> None:
+        """One lease-monitor scan (see :meth:`_wave_tick` for why this
+        is factored out of the thread loop)."""
+        expired: list[tuple[str, _Lease]] = []
+        with self._lock:
+            for task_id, lease in list(self._leases.items()):
+                if now >= lease.expires:
+                    del self._leases[task_id]
+                    self._journal("lease_drop", task_id=task_id)
+                    expired.append((task_id, lease))
             for task_id, lease in expired:
-                if not self.quiet:
-                    print(f"[tracker] lease expired for task {task_id} "
-                          f"(rank {lease.rank}, interval {lease.interval}s): "
-                          f"suspecting worker", flush=True)
-                if self.on_suspect is not None:
-                    try:
-                        self.on_suspect(task_id)
-                    except Exception:  # noqa: BLE001 — detection must survive
-                        pass
-                # Elastic fast path: a confirmed-dead task's slot is filled
-                # by pre-staging a parked spare into the forming recovery
-                # wave — promotion within one wave (doc/elasticity.md).
-                self.note_dead(task_id)
-            if expired:
-                # An expired lease may have been the last thing holding the
-                # completion guard (every shut-down rank already counted):
-                # re-evaluate, or wait() would hang on a dead straggler.
-                with self._lock:
-                    done = (0 < self.world_size <= self._n_shutdown
-                            and not (set(self._leases)
-                                     - self._shutdown_tasks))
-                if done:
-                    self.write_telemetry()
-                    self._done.set()
-                    self._release_spares()
+                self.events.append({
+                    "ts": round(time.time(), 6), "kind": "lease_expired",
+                    "task_id": task_id, "rank": lease.rank,
+                    "interval": lease.interval,
+                    "overdue": round(now - lease.expires, 6),
+                })
+        for task_id, lease in expired:
+            if not self.quiet:
+                print(f"[tracker] lease expired for task {task_id} "
+                      f"(rank {lease.rank}, interval {lease.interval}s): "
+                      f"suspecting worker", flush=True)
+            if self.on_suspect is not None:
+                try:
+                    self.on_suspect(task_id)
+                except Exception:  # noqa: BLE001 — detection must survive
+                    pass
+            # Elastic fast path: a confirmed-dead task's slot is filled
+            # by pre-staging a parked spare into the forming recovery
+            # wave — promotion within one wave (doc/elasticity.md).
+            self.note_dead(task_id)
+        if expired:
+            # An expired lease may have been the last thing holding the
+            # completion guard (every shut-down rank already counted):
+            # re-evaluate, or wait() would hang on a dead straggler.
+            with self._lock:
+                done = (0 < self.world_size <= self._n_shutdown
+                        and not (set(self._leases)
+                                 - self._shutdown_tasks))
+            if done:
+                self.write_telemetry()
+                self._done.set()
+                self._release_spares()
 
     def live_tasks(self) -> list[str]:
         """Task ids currently holding an unexpired lease."""
@@ -1847,6 +1942,7 @@ class Tracker:
                   if isinstance(s, dict) and s.get("clock")}
         return {
             "schema": TELEMETRY_SCHEMA,
+            "job": self.job,
             "world_size": self.world_size,
             "base_world": self.base_world,
             "started_at": round(self._started_at, 6),
@@ -1905,7 +2001,12 @@ class Tracker:
             return None
         try:
             os.makedirs(self.obs_dir, exist_ok=True)
-            path = os.path.join(self.obs_dir, "telemetry.json")
+            # Per-job namespacing (doc/service.md): two jobs sharing one
+            # RABIT_OBS_DIR must not clobber each other's telemetry; the
+            # bare legacy name is kept for the single-job path.
+            name = (f"telemetry-{self.job}.json" if self.job
+                    else "telemetry.json")
+            path = os.path.join(self.obs_dir, name)
             tmp = f"{path}.tmp.{os.getpid()}"
             with open(tmp, "w") as f:
                 json.dump(self.telemetry, f, indent=1, sort_keys=True)

@@ -205,6 +205,57 @@ def test_replay_determinism_gate(seed, tmp_path):
     j.close()
 
 
+@pytest.mark.parametrize("seed", [11, 22])
+def test_replay_determinism_multi_job_interleaved(seed, tmp_path):
+    """The gate, multi-tenant (doc/service.md): TWO jobs' arbitrary
+    mutation sequences interleaved (seeded shuffle) into ONE journal —
+    replay of the file lands byte-identical to the live ServiceState
+    mirror, each job's partition lands byte-identical to a SOLO replay
+    of just its records, and compaction preserves both partitions."""
+    from rabit_tpu.service import ServiceState
+
+    path = str(tmp_path / "svc.journal")
+    j = Journal(path, state=ServiceState(), seeded=False,
+                snapshot_every=10_000)
+    streams = {"a": _random_records(seed), "b": _random_records(seed + 1)}
+    rng = random.Random(seed * 7 + 1)
+    cursors = {k: 0 for k in streams}
+    interleaved: list[tuple[str, str, dict]] = []
+    while any(cursors[k] < len(streams[k]) for k in streams):
+        live = [k for k in streams if cursors[k] < len(streams[k])]
+        k = rng.choice(live)
+        kind, fields = streams[k][cursors[k]]
+        cursors[k] += 1
+        interleaved.append((k, kind, fields))
+    for job, kind, fields in interleaved:
+        j.append(kind, job=job, **fields)
+    j.append("tick", job="service")  # serving noise: must not make a job
+    assert j.flush(10.0)
+    mirror = j.state_bytes()
+    file_records, torn = read_journal(path)
+    assert not torn
+    replayed = replay(file_records, ServiceState())
+    assert replayed.snapshot_bytes() == mirror
+    assert sorted(replayed.jobs) == ["a", "b"]
+    # per-job determinism: each partition == the solo single-job replay
+    for key, stream in streams.items():
+        solo = replay([(k, dict(f)) for k, f in stream])
+        assert replayed.jobs[key].snapshot_bytes() \
+            == solo.snapshot_bytes(), key
+    j.close()
+    # compaction rewrites the file as ONE service snapshot preserving
+    # BOTH partitions byte-for-byte
+    j2 = Journal(path, state=ServiceState(), seeded=False,
+                 snapshot_every=8)
+    assert j2.state_bytes() == mirror
+    j2.close()
+    records, torn = read_journal(path)
+    assert not torn and records[0][0] == "snapshot"
+    again = replay(records, ServiceState())
+    assert again.snapshot_bytes() == mirror
+    assert sorted(again.jobs) == ["a", "b"]
+
+
 def test_torn_tail_truncation_recovery(tmp_path):
     """A torn tail record (the crash shape fsync-less appends allow)
     reads as ABSENT: replay recovers the intact prefix and reopening

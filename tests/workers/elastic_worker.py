@@ -78,9 +78,13 @@ def main() -> int:
     addrs = parse_addrs(
         Config(sys.argv[1:]).get("rabit_tracker_addrs", "") or "")
     tracker = addrs if addrs else (host, port)
+    # Multi-tenant job key (doc/service.md): the launcher exports
+    # rabit_job_key; the worker's wire task id becomes "<job>/<task>"
+    # so a CollectiveService routes it to its job's partition.
+    job = Config(sys.argv[1:]).get("rabit_job_key", "") or ""
     worker = ElasticWorker(tracker, task_id, contribution, niter,
                            spare=spare, heartbeat_sec=hb,
-                           deadline_sec=deadline, fail=fail)
+                           deadline_sec=deadline, fail=fail, job=job)
     res = worker.run()
     if res.died and fail is not None:
         return 0  # the scheduled death; the launcher must not restart it

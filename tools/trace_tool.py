@@ -48,7 +48,7 @@ from rabit_tpu.obs import trace  # noqa: E402
 def cmd_export(args: argparse.Namespace) -> int:
     doc, path, report = trace.export_job(
         args.obs_dir, out_path=args.out, fold=not args.no_fold,
-        top_k=args.top)
+        top_k=args.top, job_key=args.job)
     n_spans = sum(1 for e in doc["traceEvents"] if e.get("ph") == "X")
     other = doc["otherData"]
     print(json.dumps({
@@ -98,10 +98,10 @@ def flag_links_from_report(report: dict, telemetry: dict, addr: str,
 
 
 def cmd_report(args: argparse.Namespace) -> int:
-    job = trace.load_job(args.obs_dir)
+    job = trace.load_job(args.obs_dir, job_key=args.job)
     report = trace.straggler_report(job, top_k=args.top)
     if args.write_telemetry:
-        trace.fold_into_telemetry(args.obs_dir, report)
+        trace.fold_into_telemetry(args.obs_dir, report, job_key=args.job)
     if args.flag_links:
         links = flag_links_from_report(report, job.telemetry or {},
                                        args.flag_links,
@@ -151,6 +151,9 @@ def main(argv: list[str] | None = None) -> int:
     exp.add_argument("obs_dir")
     exp.add_argument("-o", "--out", default=None,
                      help="output path (default: OBS_DIR/trace.json)")
+    exp.add_argument("--job", default="", metavar="KEY",
+                     help="select one job of a multi-job obs dir "
+                          "(reads telemetry-KEY.json; doc/service.md)")
     exp.add_argument("--top", type=int, default=3)
     exp.add_argument("--no-fold", action="store_true",
                      help="do not fold straggler aggregates into "
@@ -159,6 +162,9 @@ def main(argv: list[str] | None = None) -> int:
 
     rep = sub.add_parser("report", help="straggler analytics")
     rep.add_argument("obs_dir")
+    rep.add_argument("--job", default="", metavar="KEY",
+                     help="select one job of a multi-job obs dir "
+                          "(reads telemetry-KEY.json; doc/service.md)")
     rep.add_argument("--top", type=int, default=3)
     rep.add_argument("--json", action="store_true")
     rep.add_argument("--write-telemetry", action="store_true",
