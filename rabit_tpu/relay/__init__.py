@@ -423,6 +423,14 @@ class Relay:
             # bootstrap blob cost the root ONE fetch; a version bump
             # invalidates the entry and proxies through (the last
             # per-call proxy, now amortized — doc/service.md).
+            # DELIBERATELY synchronous upstream (not batch-channel):
+            # the proxy runs on its own detached thread with bounded
+            # timeouts, so the child reactor never blocks (tpulint's
+            # reactor-blocking family verifies this — thread hand-offs
+            # are not call edges); blobs are large and rare, and the
+            # batch envelope is sized for control-plane records.
+            # Folding blobs into CMD_BATCH stays the follow-on for the
+            # depth-2+ relay tree (ROADMAP "N-way replicated tracker").
             job, _rest = P.split_job(h.task_id)
             with self._lock:
                 cached = self._blob_cache.get(job)

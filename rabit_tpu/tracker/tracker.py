@@ -405,6 +405,7 @@ class Tracker:
         self._started_at = time.time()
         self._n_starts: dict[str, int] = {}  # task_id -> CMD_START check-ins
         self._telemetry_written = False
+        self._telemetry_flushed = threading.Event()
         # topology: "auto" uses TPU slice metadata when present, "tpu"
         # requires it, anything else is plain host grouping.
         if host_order is None and topology in ("auto", "tpu"):
@@ -646,6 +647,7 @@ class Tracker:
         self._killed = True
         with self._lock:
             self._telemetry_written = True  # a SIGKILL leaves no gasp
+        self._telemetry_flushed.set()  # nothing to wait for either
         self._done.set()
         if self._srv is not None:
             try:
@@ -679,9 +681,14 @@ class Tracker:
         Runs at stop() AND the moment the job completes — a launcher-run
         spare process (or a surplus-parked restarted worker) must exit as
         soon as the last primary shuts down, not when the launcher tears
-        the tracker down."""
+        the tracker down.  The release is journaled as an ordinary
+        spare_drop so a standby replaying past a completed job sees the
+        same empty pool the primary holds (journal-coverage)."""
         with self._lock:
             spares, self._spares = self._spares, []
+            if spares:
+                self._journal("spare_drop",
+                              task_ids=sorted(sp.task_id for sp in spares))
         for sp in spares:
             try:
                 sp.conn.close()
@@ -746,8 +753,10 @@ class Tracker:
                     # life: the fresh worker renews once it is back up, and
                     # a stale lease must not re-suspect it mid-bootstrap.
                     tr._drop_lease_locked(tid)
-                tr._register(conn, addr[0], tid, listen_port, prev_rank,
-                             cmd)
+                plan = tr._register(conn, addr[0], tid, listen_port,
+                                    prev_rank, cmd)
+                if plan is not None:
+                    tr._send_wave(plan)  # handler thread: inline is fine
                 # conn is answered (and closed) by the wave completer.
                 return
             if cmd == P.CMD_SPARE:
@@ -892,11 +901,22 @@ class Tracker:
                     and not (set(self._leases)
                              - self._shutdown_tasks))
         if done:
-            # Persist BEFORE releasing wait()ers: by the time the
-            # launcher sees the job done, telemetry.json exists.
-            self.write_telemetry()
-            self._done.set()
-            self._release_spares()
+            # The finalize step does file IO (telemetry.json), so it
+            # must leave the serving thread — a shutdown RPC is answered
+            # by the reactor / relay fold, and a slow disk there would
+            # freeze every tenant (the reactor-blocking invariant).  The
+            # ordering contract survives the hand-off: _finalize_done
+            # persists BEFORE releasing wait()ers.
+            threading.Thread(target=self._finalize_done, daemon=True,
+                             name="rabit-tracker-finalize").start()
+
+    def _finalize_done(self) -> None:
+        """Job-completion finalizer: persist telemetry BEFORE releasing
+        wait()ers (by the time the launcher sees the job done,
+        telemetry.json exists), then free the spare pool."""
+        self.write_telemetry()
+        self._done.set()
+        self._release_spares()
 
     def _log_print(self, msg: str) -> None:
         """Fold one worker print into the BOUNDED message log and the
@@ -904,18 +924,23 @@ class Tracker:
         failure_detected prints become structured events here, so
         consumers read self.events / telemetry.json instead of scraping
         stdout."""
-        if (self.messages.maxlen is not None
-                and len(self.messages) >= self.messages.maxlen):
-            first = self.messages_dropped == 0
-            self.messages_dropped += 1
-            if first:
-                with self._lock:
+        # The message log is fed from the reactor (CMD_PRINT) AND every
+        # relay channel's fold thread concurrently; the deque append
+        # alone is GIL-atomic, but the drop counter and its one-shot
+        # event are a check-then-act — take the lock for the whole
+        # bookkeeping step (thread-shared-mutation invariant).
+        with self._lock:
+            if (self.messages.maxlen is not None
+                    and len(self.messages) >= self.messages.maxlen):
+                first = self.messages_dropped == 0
+                self.messages_dropped += 1
+                if first:
                     self.events.append({
                         "ts": round(time.time(), 6),
                         "kind": "messages_dropped",
                         "cap": self.messages.maxlen,
                     })
-        self.messages.append(msg)
+            self.messages.append(msg)
         ev = event_from_stats_line(msg)
         if ev is not None:
             with self._lock:
@@ -1047,9 +1072,10 @@ class Tracker:
                 self._reactor_detach(sel, conns, rc)
                 with tr._lock:
                     tr._drop_lease_locked(tid)
-                tr._register(rc.sock, rc.addr[0], tid,
-                             h.listen_port, h.prev_rank, h.cmd,
-                             async_send=True)
+                plan = tr._register(rc.sock, rc.addr[0], tid,
+                                    h.listen_port, h.prev_rank, h.cmd)
+                if plan is not None:
+                    tr._send_wave_async(plan)
                 return
             if h.cmd == P.CMD_SPARE:
                 # Park replies ship the cached blob (possibly large):
@@ -1260,8 +1286,10 @@ class Tracker:
                 vconn = _RelayedConn(channel, m.task_id)
                 with tr._lock:
                     tr._drop_lease_locked(tid)
-                tr._register(vconn, m.host, tid, m.listen_port,
-                             m.prev_rank, m.cmd, async_send=True)
+                plan = tr._register(vconn, m.host, tid, m.listen_port,
+                                    m.prev_rank, m.cmd)
+                if plan is not None:
+                    tr._send_wave_async(plan)
             elif m.cmd == P.CMD_SPARE:
                 tr._park_spare(_RelayedConn(channel, m.task_id), m.host,
                                tid, m.listen_port, m.prev_rank)
@@ -1314,7 +1342,13 @@ class Tracker:
         return P.put_str(f"{time.time():.6f}")
 
     def _register(self, conn, host, task_id, listen_port, prev_rank,
-                  cmd=P.CMD_START, async_send: bool = False) -> None:
+                  cmd=P.CMD_START) -> dict | None:
+        """Admit one wave check-in; returns the closed wave's send plan
+        (or None while the wave is still filling).  The CALLER delivers
+        the plan — the threaded path sends inline, the reactor and the
+        relay batch fold spawn :meth:`_send_wave_async` so an O(world)
+        assignment broadcast can never stall the accept path or the
+        fold (the reactor-blocking invariant, doc/static_analysis.md)."""
         with self._lock:
             # A re-check-in from the same task id replaces its stale entry
             # (e.g. worker retried while the wave was still filling).  The
@@ -1334,17 +1368,14 @@ class Tracker:
                 _Pending(conn, task_id, listen_port, host, prev_rank, cmd))
             if self._wave_started is None:
                 self._wave_started = time.monotonic()
-            plan = self._close_wave_locked(timer=False)
-        if plan is not None:
-            if async_send:
-                # Reactor / relay-channel callers: an O(world) assignment
-                # broadcast must not stall the accept path or the batch
-                # fold — the completer runs on its own thread.
-                threading.Thread(target=self._send_wave, args=(plan,),
-                                 daemon=True,
-                                 name="rabit-tracker-wave-send").start()
-            else:
-                self._send_wave(plan)
+            return self._close_wave_locked(timer=False)
+
+    def _send_wave_async(self, plan: dict) -> None:
+        """Deliver a wave plan on a completer thread (reactor /
+        relay-channel callers)."""
+        threading.Thread(target=self._send_wave, args=(plan,),
+                         daemon=True,
+                         name="rabit-tracker-wave-send").start()
 
     def _park_spare(self, conn, host, task_id, listen_port,
                     prev_rank) -> None:
@@ -1882,9 +1913,7 @@ class Tracker:
                         and not (set(self._leases)
                                  - self._shutdown_tasks))
             if done:
-                self.write_telemetry()
-                self._done.set()
-                self._release_spares()
+                self._finalize_done()
 
     def live_tasks(self) -> list[str]:
         """Task ids currently holding an unexpired lease."""
@@ -1991,15 +2020,21 @@ class Tracker:
     def write_telemetry(self) -> str | None:
         """Write telemetry.json into the obs dir (atomic rename so a
         concurrent reader never sees a torn file).  Idempotent: the first
-        caller wins; returns the path, or None when no obs dir is set."""
+        caller wins; returns the path, or None when no obs dir is set.
+        A LOSING caller blocks until the winner's file is on disk —
+        the completion finalizer runs on its own thread (reactor
+        discipline), and "stop() returned" must still imply
+        telemetry.json exists."""
         with self._lock:
-            if self._telemetry_written:
-                return None
+            claimed = self._telemetry_written
             self._telemetry_written = True
-        self.telemetry = self.build_telemetry()
-        if not self.obs_dir:
+        if claimed:
+            self._telemetry_flushed.wait(5.0)
             return None
         try:
+            self.telemetry = self.build_telemetry()
+            if not self.obs_dir:
+                return None
             os.makedirs(self.obs_dir, exist_ok=True)
             # Per-job namespacing (doc/service.md): two jobs sharing one
             # RABIT_OBS_DIR must not clobber each other's telemetry; the
@@ -2014,4 +2049,6 @@ class Tracker:
             return path
         except OSError:
             return None  # observability must not fail the job
+        finally:
+            self._telemetry_flushed.set()
 

@@ -12,10 +12,38 @@ cd "$(dirname "$0")/.."
 python -m compileall -q rabit_tpu rabit_tpu/obs rabit_tpu/compress rabit_tpu/elastic rabit_tpu/sched rabit_tpu/quorum rabit_tpu/relay rabit_tpu/ha rabit_tpu/service rabit_tpu/obs/trace.py rabit_tpu/chaos.py rabit_tpu/engine/fused.py tests guide tools tools/trace_tool.py tools/service_bench.py bench.py __graft_entry__.py
 
 # tpulint (doc/static_analysis.md): lock discipline, event-kind registry,
-# config-key discipline, wire-protocol symmetry.  Fails on any finding not
-# carried (with a justification) in tools/tpulint/baseline.json.
-python -m tools.tpulint
+# config-key discipline, wire-protocol symmetry, plus the interprocedural
+# v2 families (reactor-blocking, journal-coverage, lock-order,
+# thread-ownership).  Fails on any finding not carried (with a
+# justification) in tools/tpulint/baseline.json — and on blowing the
+# wall-time budget, which keeps the whole-repo call-graph pass honest as
+# the tree grows.
+python - <<'EOF'
+import sys, time
+from tools.tpulint.__main__ import main
+
+BUDGET_SEC = 15.0
+t0 = time.monotonic()
+rc = main([])
+dt = time.monotonic() - t0
+print(f"tpulint wall time: {dt:.2f}s (budget {BUDGET_SEC:.0f}s)")
+if rc == 0 and dt > BUDGET_SEC:
+    print(f"tpulint: exceeded the {BUDGET_SEC:.0f}s runtime budget",
+          file=sys.stderr)
+    rc = 3
+sys.exit(rc)
+EOF
 
 make -C native clean > /dev/null
 make -C native CXXFLAGS="-O2 -std=c++17 -fPIC -Wall -Wextra -Wno-unused-parameter -Werror" > /dev/null
+
+# TPULINT_SANITIZE=1 extends the concurrency story to the native side from
+# the same entry point: the tsan and asan-ubsan targets build instrumented
+# libtpurabit + unit tests from sources and run them (doc/static_analysis.md
+# "Sanitizer targets") — the C++ analog of the Python lock/ownership rules.
+if [ "${TPULINT_SANITIZE:-0}" = "1" ]; then
+  make -C native tsan
+  make -C native asan-ubsan
+fi
+
 echo "lint OK"

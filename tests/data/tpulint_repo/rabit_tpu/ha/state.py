@@ -1,0 +1,23 @@
+"""tpulint fixture: journal kind-catalogue closure (ControlState side).
+
+``_apply_lease`` pairs with the fixture tracker's ``_journal("lease")``
+append (the healthy case); ``_apply_orphan`` has no producer anywhere —
+the rename-drift shape ``journal-apply-dead`` must catch.
+"""
+
+
+class ControlState:
+    def __init__(self):
+        self.leases = {}
+
+    def apply(self, kind, fields):
+        getattr(self, f"_apply_{kind}", self._apply_ignore)(fields)
+
+    def _apply_ignore(self, fields):
+        pass
+
+    def _apply_lease(self, fields):
+        self.leases[str(fields["task_id"])] = 1
+
+    def _apply_orphan(self, fields):  # SEEDED: journal-apply-dead
+        self.leases.clear()

@@ -1,4 +1,9 @@
-"""tpulint fixture: a blocking call under a held lock."""
+"""tpulint fixture: one seeded violation per rule that anchors here.
+
+Not product code — a miniature repo-shaped tree that
+tests/test_tpulint.py points ``python -m tools.tpulint --root`` at.
+Each ``SEEDED:`` comment marks the exact line a finding must name.
+"""
 
 import threading
 import time
@@ -14,3 +19,59 @@ class Registrar:
         if cmd == CMD_START:
             with self._lock:
                 time.sleep(0.1)  # SEEDED: lock-blocking-call
+
+
+class Reactor:
+    """v2 interprocedural seeds: the reactor entry reaches a blocking
+    call through a helper, the monitor tick mutates journaled and
+    shared state, and two methods take the same two locks in opposite
+    order."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._aux_lock = threading.Lock()
+        self._leases = {}
+        self._cursor = 0
+
+    def _journal(self, kind, **fields):
+        return (kind, fields)
+
+    # -- reactor context ---------------------------------------------------
+
+    def _reactor_read(self, sock):
+        return self._ingest(sock)
+
+    def _ingest(self, sock):
+        data = sock.recv(4096)  # SEEDED: reactor-blocking
+        self._cursor += 1  # SEEDED: thread-shared-mutation
+        return data
+
+    def _serve_reactor(self, sel):
+        with self._lock:
+            sel.select(0.05)  # SEEDED: lock-across-reactor-wait
+
+    # -- monitor context ---------------------------------------------------
+
+    def _lease_tick(self, now):
+        self._leases.pop("w0", None)  # SEEDED: journal-unpaired-mutation
+        self._cursor = 0
+
+    def _renew(self, task_id):
+        # the healthy pairing: mutation + journal on the same path
+        self._leases[task_id] = 1.0
+        self._journal("lease", task_id=task_id)
+
+    def _freeze(self):
+        self._journal("rogue_record", x=1)  # SEEDED: journal-kind-unapplied
+
+    # -- lock order --------------------------------------------------------
+
+    def _grab_fwd(self):
+        with self._lock:
+            with self._aux_lock:
+                return self._cursor
+
+    def _grab_rev(self):
+        with self._aux_lock:
+            with self._lock:  # SEEDED: lock-order-cycle
+                return self._cursor

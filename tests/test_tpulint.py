@@ -61,6 +61,18 @@ def seeded_line(relpath: str, rule: str) -> int:
     ("wire-struct-oneway", "rabit_tpu/tracker/protocol.py"),
     ("wire-frame-oneway", "rabit_tpu/tracker/protocol.py"),
     ("wire-native-prefix", "native/src/comm.cc"),
+    # v2 interprocedural families (ISSUE 13): reactor-blocking reaches
+    # its call through a helper (depth 2), journal-coverage closes the
+    # mutation<->append pairing and the kind catalogue both ways,
+    # lock-order catches the reversed pair and the held-across-select,
+    # thread-ownership the cross-context unprotected mutation.
+    ("reactor-blocking", "rabit_tpu/tracker/tracker.py"),
+    ("journal-unpaired-mutation", "rabit_tpu/tracker/tracker.py"),
+    ("journal-kind-unapplied", "rabit_tpu/tracker/tracker.py"),
+    ("journal-apply-dead", "rabit_tpu/ha/state.py"),
+    ("lock-order-cycle", "rabit_tpu/tracker/tracker.py"),
+    ("lock-across-reactor-wait", "rabit_tpu/tracker/tracker.py"),
+    ("thread-shared-mutation", "rabit_tpu/tracker/tracker.py"),
 ])
 def test_fixture_violation_flagged(rule, relpath):
     proc = run_tpulint("--root", str(FIXTURE))
@@ -155,3 +167,137 @@ def test_fingerprints_are_line_number_free():
     for f in doc["new"]:
         rule, path, token = f["fingerprint"].split(":", 2)
         assert str(f["line"]) not in token.split(":"), f
+
+
+def test_prune_rewrites_baseline_without_stale_entries(tmp_path):
+    """--prune round-trip: stale entries are removed, live entries keep
+    their justifications verbatim, and the pruned file loads clean."""
+    baseline = tmp_path / "baseline.json"
+    run_tpulint("--root", str(FIXTURE), "--baseline", str(baseline),
+                "--write-baseline")
+    doc = json.loads(baseline.read_text())
+    for i, entry in enumerate(doc["suppressions"]):
+        entry["justification"] = f"fixture: seeded violation #{i}"
+    live = {e["fingerprint"]: e["justification"]
+            for e in doc["suppressions"]}
+    doc["suppressions"].append({
+        "fingerprint": "lock-blocking-call:rabit_tpu/gone.py:f:lock:sleep",
+        "justification": "covers a finding that no longer exists",
+    })
+    baseline.write_text(json.dumps(doc))
+
+    proc = run_tpulint("--root", str(FIXTURE), "--baseline", str(baseline),
+                       "--prune")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "pruned 1 stale baseline entry" in proc.stdout
+
+    pruned = json.loads(baseline.read_text())
+    kept = {e["fingerprint"]: e["justification"]
+            for e in pruned["suppressions"]}
+    assert kept == live  # stale gone, live justifications verbatim
+
+    proc = run_tpulint("--root", str(FIXTURE), "--baseline", str(baseline))
+    assert proc.returncode == 0
+    assert "0 stale" in proc.stdout
+
+
+def test_json_dump_to_file(tmp_path):
+    """--json PATH writes the machine-readable document (for CI diffing
+    of finding sets across commits) while keeping the human output."""
+    out = tmp_path / "findings.json"
+    proc = run_tpulint("--root", str(FIXTURE), "--json", str(out))
+    assert proc.returncode == 1
+    assert "[reactor-blocking]" in proc.stdout  # human output intact
+    doc = json.loads(out.read_text())
+    assert doc["counts"]["new"] == len(doc["new"]) > 0
+    rules = {f["rule"] for f in doc["new"]}
+    assert "reactor-blocking" in rules
+    for f in doc["new"]:
+        assert set(f) >= {"rule", "path", "line", "message", "fingerprint"}
+
+
+# -- call-graph substrate unit tests ------------------------------------------
+
+def _graph_over(tmp_path, sources: dict[str, str]):
+    from tools.tpulint.callgraph import CallGraph
+    paths = []
+    for relpath, text in sources.items():
+        p = tmp_path / relpath
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(text)
+        paths.append(p)
+    return CallGraph.build(paths, tmp_path)
+
+
+_CHAIN_SRC = """
+class Base:
+    def entry(self):
+        self.hop1()
+    def hop1(self):
+        self.hop2()
+    def hop2(self):
+        self.hop3()
+    def hop3(self):
+        helper()
+
+def helper():
+    tail()
+
+def tail():
+    pass
+
+
+class Sub(Base):
+    def hop1(self):
+        self.leaf()
+    def leaf(self):
+        pass
+
+
+def r1():
+    r2()
+
+def r2():
+    r1()
+"""
+
+
+def test_callgraph_depth_bound(tmp_path):
+    g = _graph_over(tmp_path, {"pkg/a.py": _CHAIN_SRC})
+    entry = "pkg/a.py::Base.entry"
+    shallow = g.reachable([entry], max_depth=2)
+    assert f"pkg/a.py::Base.hop2" in shallow
+    assert f"pkg/a.py::Base.hop3" not in shallow  # cut by the bound
+    deep = g.reachable([entry], max_depth=10)
+    assert "pkg/a.py::tail" in deep  # entry->hop1..3->helper->tail
+
+
+def test_callgraph_override_dispatch(tmp_path):
+    """A base-class self-call must also reach subclass overrides (the
+    service's _route_hello pattern)."""
+    g = _graph_over(tmp_path, {"pkg/a.py": _CHAIN_SRC})
+    reach = g.reachable(["pkg/a.py::Base.entry"])
+    assert "pkg/a.py::Sub.hop1" in reach
+    assert "pkg/a.py::Sub.leaf" in reach
+    chain = g.chain(reach, "pkg/a.py::Sub.leaf")
+    assert chain[0] == "entry" and chain[-1] == "leaf"
+
+
+def test_callgraph_cycle_terminates(tmp_path):
+    g = _graph_over(tmp_path, {"pkg/a.py": _CHAIN_SRC})
+    reach = g.reachable(["pkg/a.py::r1"], max_depth=10)
+    assert {"pkg/a.py::r1", "pkg/a.py::r2"} <= set(reach)
+
+
+def test_callgraph_cross_module_resolution(tmp_path):
+    g = _graph_over(tmp_path, {
+        "pkg/a.py": "def helper():\n    pass\n",
+        "pkg/b.py": ("from pkg import a\n"
+                     "from pkg.a import helper as h\n"
+                     "def caller():\n"
+                     "    a.helper()\n"
+                     "def caller2():\n"
+                     "    h()\n"),
+    })
+    for entry in ("pkg/b.py::caller", "pkg/b.py::caller2"):
+        assert "pkg/a.py::helper" in g.reachable([entry]), entry

@@ -26,10 +26,30 @@ rule guards against):
   branch, and ``struct`` formats must be used on both the pack and the
   unpack side.
 
+The v2 families share one whole-repo call graph
+(``tools/tpulint/callgraph.py``: MRO + subclass-override resolution,
+import-aware module calls, bounded-depth reachability):
+
+* **reactor-blocking** — no blocking call reachable from the tracker
+  reactor's handlers, the relay batch fold, or the relay's child
+  reactor: one stalled callback freezes every tenant of the control
+  plane.
+* **journal-coverage** (``journal-*``) — every mutation of journaled
+  control-plane state pairs with a ``_journal(...)`` append on the same
+  call path, and the replay-kind catalogue is closed both ways against
+  ``ControlState._apply_*`` / ``ServiceState`` routing (doc/ha.md).
+* **lock-order** (``lock-order-cycle`` / ``lock-across-reactor-wait``)
+  — whole-repo lock-acquisition graph with cycle detection, plus locks
+  held across a ``select()`` boundary.
+* **thread-ownership** (``thread-shared-mutation``) — tracker/service
+  state touched from two thread contexts (reactor, relay channels,
+  monitor ticks, wave completer) must be mutated under a lock.
+
 Findings are suppressed only via the baseline file
 (``tools/tpulint/baseline.json``); every suppression carries a one-line
-justification and the tool rejects baselines without one.  Pure stdlib
-(``ast`` + ``re``); no third-party dependencies.
+justification and the tool rejects baselines without one (``--prune``
+drops stale entries).  Pure stdlib (``ast`` + ``re``); no third-party
+dependencies.
 """
 
 from tools.tpulint.core import Finding, load_baseline  # noqa: F401

@@ -1,9 +1,17 @@
-"""CLI: ``python -m tools.tpulint [--root DIR] [--json] [--write-baseline]``.
+"""CLI: ``python -m tools.tpulint [--root DIR] [--json [PATH]]
+[--write-baseline] [--prune]``.
 
 Exit status: 0 — clean (every finding baselined with a justification);
 1 — new findings; 2 — malformed baseline or internal error.  Stale
 baseline entries (suppressing nothing) are reported but do not fail the
-run — prune them when touching the baseline.
+run — ``--prune`` rewrites the baseline without them (justifications of
+live entries preserved).
+
+``--json`` alone prints the machine-readable findings document on
+stdout; ``--json out.json`` writes it to a file alongside the normal
+human output, so CI can diff finding sets across commits.  The
+document's ``new`` entries carry rule/path/line/message/fingerprint;
+``suppressed``/``stale_baseline`` carry fingerprints.
 
 ``--root`` points at an alternate tree with the repo's layout (used by
 the fixture tests in tests/test_tpulint.py); the default is this repo.
@@ -16,13 +24,24 @@ import json
 import sys
 from pathlib import Path
 
-from tools.tpulint import configkeys, locks, registry, wire
+from tools.tpulint import (
+    callgraph,
+    configkeys,
+    journalcov,
+    lockorder,
+    locks,
+    ownership,
+    reactor,
+    registry,
+    wire,
+)
 from tools.tpulint.core import (
     BaselineError,
     Finding,
     iter_python_files,
     load_baseline,
     rel,
+    save_baseline,
     write_baseline,
 )
 
@@ -31,7 +50,7 @@ _EXCLUDE_PARTS = ("data",)  # tests/data: fixture trees with seeded bugs
 
 
 def run(root: Path) -> list[Finding]:
-    """All four families over a repo-layout tree rooted at ``root``."""
+    """All check families over a repo-layout tree rooted at ``root``."""
     findings: list[Finding] = []
 
     # 1. lock discipline — the whole package (tracker, obs, store, chaos,
@@ -88,8 +107,27 @@ def run(root: Path) -> list[Finding]:
     findings += wire.check_wire(protocol_py, tracker_py, comm_h,
                                 struct_files, root, comm_cc=comm_cc)
 
+    # 5-8. the interprocedural families (doc/static_analysis.md "v2"):
+    # one shared call graph over the product tree feeds reactor-blocking,
+    # journal-coverage, lock-order and thread-ownership.
+    graph = callgraph.CallGraph.build(lock_files, root)
+    findings += reactor.check_reactor(graph, root)
+    findings += journalcov.check_journal(graph, root)
+    findings += lockorder.check_lock_order(graph, root)
+    findings += ownership.check_ownership(graph, root)
+
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
     return findings
+
+
+def _json_doc(new, suppressed, stale) -> dict:
+    return {
+        "new": [f.__dict__ | {"fingerprint": f.fingerprint} for f in new],
+        "suppressed": [f.fingerprint for f in suppressed],
+        "stale_baseline": stale,
+        "counts": {"new": len(new), "suppressed": len(suppressed),
+                   "stale": len(stale)},
+    }
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -106,8 +144,14 @@ def main(argv: list[str] | None = None) -> int:
                     help="write current findings as TODO-justified "
                          "baseline entries and exit (the tool refuses to "
                          "load TODOs — fill in each justification)")
-    ap.add_argument("--json", action="store_true",
-                    help="machine-readable findings on stdout")
+    ap.add_argument("--prune", action="store_true",
+                    help="rewrite the baseline without stale entries "
+                         "(live justifications preserved) and exit")
+    ap.add_argument("--json", nargs="?", const="-", default=None,
+                    metavar="PATH",
+                    help="machine-readable findings: bare --json prints "
+                         "the document on stdout, --json PATH writes it "
+                         "to a file alongside the normal output")
     args = ap.parse_args(argv)
 
     root = Path(args.root).resolve() if args.root else \
@@ -134,23 +178,32 @@ def main(argv: list[str] | None = None) -> int:
     suppressed = [f for f in findings if f.fingerprint in baseline]
     stale = sorted(set(baseline) - {f.fingerprint for f in findings})
 
-    if args.json:
-        print(json.dumps({
-            "new": [f.__dict__ | {"fingerprint": f.fingerprint}
-                    for f in new],
-            "suppressed": [f.fingerprint for f in suppressed],
-            "stale_baseline": stale,
-        }, indent=1))
-    else:
-        for f in new:
-            print(f.render())
+    if args.prune:
+        kept = {fp: why for fp, why in baseline.items() if fp not in stale}
+        save_baseline(baseline_path, kept)
+        print(f"tpulint: pruned {len(stale)} stale baseline entr"
+              f"{'y' if len(stale) == 1 else 'ies'} "
+              f"({len(kept)} kept) in {baseline_path}")
         for fp in stale:
-            print(f"tpulint: stale baseline entry (suppresses nothing): "
-                  f"{fp}")
-        summary = (f"tpulint: {len(new)} new finding(s), "
-                   f"{len(suppressed)} baselined, {len(stale)} stale "
-                   f"baseline entr{'y' if len(stale) == 1 else 'ies'}")
-        print(summary)
+            print(f"tpulint: pruned: {fp}")
+        return 0
+
+    doc = _json_doc(new, suppressed, stale)
+    if args.json == "-":
+        print(json.dumps(doc, indent=1))
+        return 1 if new else 0
+    if args.json is not None:
+        Path(args.json).write_text(json.dumps(doc, indent=1) + "\n",
+                                   encoding="utf-8")
+    for f in new:
+        print(f.render())
+    for fp in stale:
+        print(f"tpulint: stale baseline entry (suppresses nothing): "
+              f"{fp}")
+    summary = (f"tpulint: {len(new)} new finding(s), "
+               f"{len(suppressed)} baselined, {len(stale)} stale "
+               f"baseline entr{'y' if len(stale) == 1 else 'ies'}")
+    print(summary)
     return 1 if new else 0
 
 

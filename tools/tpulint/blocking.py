@@ -1,0 +1,139 @@
+"""Shared blocking-call classifier (locks.py's rule set, factored out so
+the interprocedural reactor family and the lexical lock family flag the
+same calls for the same reasons).
+
+Two exemption layers exist for the reactor family only (``timed_ok``):
+
+* a call inside a ``try`` whose handlers catch ``BlockingIOError`` /
+  ``InterruptedError`` is evidence of a non-blocking socket — the
+  reactor's own recv/accept/send are all written this way;
+* an argument mentioning ``MSG_DONTWAIT``/``MSG_PEEK`` makes a recv
+  non-blocking regardless of socket mode (``_conn_dead``'s peek);
+* ``.wait(timeout)``/``socket.create_connection(..., timeout=)`` are
+  bounded, not blocking-forever.
+
+The lock family deliberately does NOT take these exemptions: even a
+bounded wait under a shared lock stalls every other holder for its
+duration.
+"""
+
+from __future__ import annotations
+
+import ast
+
+#: Attribute names that block regardless of receiver (socket/file/thread
+#: shaped).  ``join`` is deliberately absent: ``str.join`` would swamp the
+#: signal; thread joins under a lock are caught via ``wait``/helpers.
+BLOCKING_ATTRS = frozenset({
+    "recv", "recv_into", "recvfrom", "recv_exact",
+    "send", "sendall", "sendto",
+    "accept", "connect", "connect_ex",
+    "wait", "communicate",
+    "read_bytes", "write_bytes", "read_text", "write_text",
+})
+
+#: module-level calls: {module name: attrs} (None = every attr blocks).
+BLOCKING_MODULE_ATTRS: dict[str, frozenset | None] = {
+    "subprocess": None,
+    "time": frozenset({"sleep"}),
+    "socket": frozenset({"create_connection", "getaddrinfo"}),
+    "os": frozenset({"fsync"}),
+}
+
+#: bare-name calls that block.
+BLOCKING_NAMES = frozenset({"open", "sleep", "tracker_rpc"})
+
+#: exception names whose handler marks the guarded calls non-blocking.
+_NONBLOCK_EXCS = frozenset({"BlockingIOError", "InterruptedError"})
+
+
+def blocking_reason(call: ast.Call) -> str | None:
+    """Describe why this call blocks, else None (no exemptions — the
+    lexical lock rule's exact classifier)."""
+    fn = call.func
+    if isinstance(fn, ast.Attribute):
+        if (isinstance(fn.value, ast.Name)
+                and fn.value.id in BLOCKING_MODULE_ATTRS):
+            allowed = BLOCKING_MODULE_ATTRS[fn.value.id]
+            if allowed is None or fn.attr in allowed:
+                return f"{fn.value.id}.{fn.attr}"
+        if fn.attr in BLOCKING_ATTRS:
+            return f".{fn.attr}"
+        if fn.attr == "tracker_rpc":
+            return "tracker_rpc"
+    elif isinstance(fn, ast.Name) and fn.id in BLOCKING_NAMES:
+        return fn.id
+    return None
+
+
+def _mentions_nonblocking_flag(call: ast.Call) -> bool:
+    for arg in call.args:
+        for node in ast.walk(arg):
+            name = (node.attr if isinstance(node, ast.Attribute)
+                    else node.id if isinstance(node, ast.Name) else "")
+            if name in ("MSG_DONTWAIT", "MSG_PEEK"):
+                return True
+    return False
+
+
+def _is_timed(call: ast.Call) -> bool:
+    fn = call.func
+    attr = fn.attr if isinstance(fn, ast.Attribute) else ""
+    if attr == "wait" and (call.args or call.keywords):
+        return True  # Event.wait(timeout) / Condition.wait(timeout)
+    if any(kw.arg == "timeout" for kw in call.keywords):
+        return True  # create_connection(..., timeout=...) and friends
+    return False
+
+
+def guarded_calls(func_node: ast.FunctionDef) -> set[int]:
+    """``id()`` of every Call inside a try-body whose handlers catch a
+    non-blocking-socket exception (nested defs excluded)."""
+    out: set[int] = set()
+
+    def exc_names(handler: ast.ExceptHandler) -> set[str]:
+        t = handler.type
+        nodes = t.elts if isinstance(t, ast.Tuple) else ([t] if t else [])
+        return {n.id for n in nodes if isinstance(n, ast.Name)}
+
+    stack: list[tuple[ast.AST, bool]] = [(func_node, False)]
+    while stack:
+        node, guarded = stack.pop()
+        if node is not func_node and isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                       ast.Lambda, ast.ClassDef)):
+            continue
+        if isinstance(node, ast.Call) and guarded:
+            out.add(id(node))
+        if isinstance(node, ast.Try):
+            here = guarded or any(exc_names(h) & _NONBLOCK_EXCS
+                                  for h in node.handlers)
+            for child in node.body:
+                stack.append((child, here))
+            for part in (node.handlers, node.orelse, node.finalbody):
+                for child in part:
+                    stack.append((child, guarded))
+            continue
+        for child in ast.iter_child_nodes(node):
+            stack.append((child, guarded))
+    return out
+
+
+def iter_blocking_calls(func_node: ast.FunctionDef):
+    """(call, why) for every call in ``func_node`` that can block a
+    reactor thread: the shared classifier minus the guarded/flagged/
+    timed exemptions documented in the module docstring."""
+    guarded = guarded_calls(func_node)
+    stack: list[ast.AST] = list(func_node.body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            continue
+        if isinstance(node, ast.Call):
+            why = blocking_reason(node)
+            if why is not None and id(node) not in guarded \
+                    and not _mentions_nonblocking_flag(node) \
+                    and not _is_timed(node):
+                yield node, why
+        stack.extend(ast.iter_child_nodes(node))

@@ -1,0 +1,404 @@
+"""Whole-repo call-graph substrate for the interprocedural check families.
+
+PR 4's checks were lexical with one-level helper resolution; the v2
+families (reactor-blocking, journal-coverage, lock-order,
+thread-ownership — doc/static_analysis.md) all need the same three
+questions answered across module boundaries:
+
+* *who is this call?* — ``self.meth()`` resolved through the defining
+  class and its MRO (bases found by name across every indexed module),
+  ``module.func()`` through the import table, bare ``func()`` in the
+  same module, ``Class(...)`` to ``Class.__init__``;
+* *who overrides it?* — a virtual call from a base-class method must
+  also reach every indexed subclass override (the reactor's
+  ``self._route_hello`` dispatches into ``CollectiveService``'s);
+* *what is reachable from here?* — bounded-depth BFS (``MAX_DEPTH``),
+  cycle-safe, with the shortest call chain retained for evidence.
+
+Deliberate approximations (kept conservative for the checks built on
+top):
+
+* attribute calls on an unknown receiver (``tr._register(...)``,
+  ``part._wave_tick()``) resolve by METHOD NAME when at most
+  :data:`FALLBACK_FANOUT` indexed classes define a method of that name
+  and the name is private (``_``-prefixed) — the tracker's routed-
+  partition calls stay visible without ``append``-style names fanning
+  out to everything;
+* ``threading.Thread(target=f)`` is a *spawn*, not a call: the target
+  runs on another thread, so spawn targets are deliberately NOT edges
+  (a reactor handing work to a thread is the FIX for blocking, not an
+  instance of it);
+* nested ``def``/``lambda`` bodies are excluded from their enclosing
+  function (deferred execution) and are not indexed.
+
+Pure stdlib ``ast``; built once per lint run and shared by every
+family.
+"""
+
+from __future__ import annotations
+
+import ast
+from collections import deque
+from dataclasses import dataclass, field
+from pathlib import Path
+
+from tools.tpulint.core import parse_python, rel
+
+#: Reachability bound for every BFS built on this graph.  Deep enough
+#: for the longest real dispatch chain we guard (reactor read ->
+#: _route_hello -> admit -> partition construction -> journal
+#: bootstrap is depth 7); shallow enough that an accidental cycle or a
+#: resolution explosion cannot make the lint pass unbounded.
+MAX_DEPTH = 10
+
+#: An unknown-receiver method name resolves only when at most this many
+#: indexed classes define it (and it is ``_``-private).
+FALLBACK_FANOUT = 3
+
+
+@dataclass
+class FuncInfo:
+    qual: str                   # "rel/path.py::Class.meth" | "rel/path.py::func"
+    module: str                 # repo-relative posix path
+    cls: str | None             # owning class name, None for module funcs
+    name: str                   # bare function name
+    node: ast.FunctionDef
+
+
+@dataclass
+class ClassInfo:
+    name: str
+    module: str
+    bases: list[str] = field(default_factory=list)   # base names as written
+    methods: dict[str, FuncInfo] = field(default_factory=dict)
+    #: instance attributes assigned as ``self.X = ...`` in __init__
+    init_attrs: dict[str, int] = field(default_factory=dict)  # attr -> line
+    #: init attrs assigned from a threading.RLock() call (reentrant)
+    rlock_attrs: set[str] = field(default_factory=set)
+    #: init attrs assigned a container (literal or list/dict/set/deque
+    #: call) — the only attrs whose ``.append()``-style calls count as
+    #: mutations for the ownership family
+    container_attrs: set[str] = field(default_factory=set)
+
+    @property
+    def key(self) -> str:
+        return f"{self.module}::{self.name}"
+
+
+def body_calls(node: ast.AST):
+    """Every ``ast.Call`` lexically inside ``node``'s body, excluding
+    nested function/class/lambda bodies (deferred execution)."""
+    roots = node.body if hasattr(node, "body") else [node]
+    stack: list[ast.AST] = list(roots)
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda, ast.ClassDef)):
+            continue
+        if isinstance(n, ast.Call):
+            yield n
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def _module_name_to_path(dotted: str, known: set[str]) -> str | None:
+    """Resolve a dotted module name against the indexed file set."""
+    base = dotted.replace(".", "/")
+    for cand in (f"{base}.py", f"{base}/__init__.py"):
+        if cand in known:
+            return cand
+    return None
+
+
+class CallGraph:
+    """Index + resolved call edges over one repo-layout tree."""
+
+    def __init__(self) -> None:
+        self.trees: dict[str, ast.Module] = {}
+        self.funcs: dict[str, FuncInfo] = {}
+        self.classes: dict[str, ClassInfo] = {}          # key -> info
+        self.class_by_name: dict[str, list[ClassInfo]] = {}
+        self.methods_by_name: dict[str, list[FuncInfo]] = {}
+        self.module_funcs: dict[str, dict[str, FuncInfo]] = {}
+        self.module_classes: dict[str, dict[str, ClassInfo]] = {}
+        #: per-module import alias table: alias -> ("mod", relpath) |
+        #: ("sym", relpath, name)
+        self.imports: dict[str, dict[str, tuple]] = {}
+        self.subclasses: dict[str, list[ClassInfo]] = {}
+        self._edges: dict[str, list[tuple[str, ast.Call]]] = {}
+
+    # -- construction -------------------------------------------------------
+
+    @classmethod
+    def build(cls, files: list[Path], root: Path) -> "CallGraph":
+        g = cls()
+        trees: dict[str, ast.Module] = {}
+        for path in files:
+            tree = parse_python(path)
+            if tree is None:
+                continue
+            trees[rel(path, root)] = tree
+        known = set(trees)
+        g.trees = trees
+        for rpath, tree in trees.items():
+            g._index_module(rpath, tree, known)
+        g._link_classes()
+        for qual in g.funcs:
+            g._edges[qual] = g._resolve_calls(qual)
+        return g
+
+    def _index_module(self, rpath: str, tree: ast.Module,
+                      known: set[str]) -> None:
+        self.module_funcs.setdefault(rpath, {})
+        self.module_classes.setdefault(rpath, {})
+        imports = self.imports.setdefault(rpath, {})
+        # imports anywhere in the module (function-level imports count —
+        # tracker.py lazy-imports Journal inside __init__)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    tgt = _module_name_to_path(a.name, known)
+                    if tgt is not None:
+                        imports[a.asname or a.name.split(".")[0]] = \
+                            ("mod", tgt)
+            elif isinstance(node, ast.ImportFrom) and node.module \
+                    and node.level == 0:
+                src = _module_name_to_path(node.module, known)
+                for a in node.names:
+                    sub = _module_name_to_path(
+                        f"{node.module}.{a.name}", known)
+                    if sub is not None:
+                        imports[a.asname or a.name] = ("mod", sub)
+                    elif src is not None:
+                        imports[a.asname or a.name] = ("sym", src, a.name)
+        for node in tree.body:
+            if isinstance(node, ast.FunctionDef):
+                self._add_func(rpath, None, node)
+            elif isinstance(node, ast.ClassDef):
+                info = ClassInfo(
+                    name=node.name, module=rpath,
+                    bases=[b.id if isinstance(b, ast.Name)
+                           else b.attr if isinstance(b, ast.Attribute)
+                           else "" for b in node.bases])
+                self.classes[info.key] = info
+                self.class_by_name.setdefault(node.name, []).append(info)
+                self.module_classes[rpath][node.name] = info
+                for item in node.body:
+                    if isinstance(item, ast.FunctionDef):
+                        fi = self._add_func(rpath, node.name, item)
+                        info.methods[item.name] = fi
+                        if item.name == "__init__":
+                            self._collect_init_attrs(info, item)
+
+    def _add_func(self, rpath: str, cls_name: str | None,
+                  node: ast.FunctionDef) -> FuncInfo:
+        qual = (f"{rpath}::{cls_name}.{node.name}" if cls_name
+                else f"{rpath}::{node.name}")
+        fi = FuncInfo(qual, rpath, cls_name, node.name, node)
+        self.funcs[qual] = fi
+        self.methods_by_name.setdefault(node.name, []).append(fi)
+        if cls_name is None:
+            self.module_funcs[rpath][node.name] = fi
+        return fi
+
+    @staticmethod
+    def _collect_init_attrs(info: ClassInfo, init: ast.FunctionDef) -> None:
+        for node in ast.walk(init):
+            targets: list[ast.expr] = []
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    targets.extend(t.elts if isinstance(
+                        t, (ast.Tuple, ast.List)) else [t])
+                value = node.value
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                targets = [node.target]
+                value = node.value
+            else:
+                continue
+            for t in targets:
+                if isinstance(t, ast.Attribute) \
+                        and isinstance(t.value, ast.Name) \
+                        and t.value.id == "self":
+                    info.init_attrs.setdefault(t.attr, t.lineno)
+                    if isinstance(value, (ast.List, ast.Dict, ast.Set,
+                                          ast.Tuple, ast.ListComp,
+                                          ast.DictComp, ast.SetComp)):
+                        info.container_attrs.add(t.attr)
+                    if isinstance(value, ast.Call):
+                        fn = value.func
+                        name = (fn.attr if isinstance(fn, ast.Attribute)
+                                else fn.id if isinstance(fn, ast.Name)
+                                else "")
+                        if name == "RLock":
+                            info.rlock_attrs.add(t.attr)
+                        elif name in ("list", "dict", "set", "deque",
+                                      "defaultdict", "OrderedDict"):
+                            info.container_attrs.add(t.attr)
+
+    def _link_classes(self) -> None:
+        for info in self.classes.values():
+            for base in self.mro(info)[1:]:
+                self.subclasses.setdefault(base.key, []).append(info)
+
+    # -- resolution ---------------------------------------------------------
+
+    def mro(self, info: ClassInfo) -> list[ClassInfo]:
+        """The class plus its resolvable base chain (name-resolved
+        through imports, then across every indexed module), cycle-safe."""
+        out, seen = [], set()
+        queue = [info]
+        while queue:
+            c = queue.pop(0)
+            if c.key in seen:
+                continue
+            seen.add(c.key)
+            out.append(c)
+            for base in c.bases:
+                resolved = self._resolve_class_name(base, c.module)
+                queue.extend(resolved)
+        return out
+
+    def _resolve_class_name(self, name: str, module: str) -> list[ClassInfo]:
+        local = self.module_classes.get(module, {}).get(name)
+        if local is not None:
+            return [local]
+        imp = self.imports.get(module, {}).get(name)
+        if imp is not None and imp[0] == "sym":
+            tgt = self.module_classes.get(imp[1], {}).get(imp[2])
+            if tgt is not None:
+                return [tgt]
+        return self.class_by_name.get(name, [])[:1]
+
+    def _method_in_mro(self, info: ClassInfo, name: str,
+                       skip_self: bool = False) -> FuncInfo | None:
+        for c in self.mro(info)[1 if skip_self else 0:]:
+            m = c.methods.get(name)
+            if m is not None:
+                return m
+        return None
+
+    def _override_targets(self, info: ClassInfo, name: str) -> list[FuncInfo]:
+        """Subclass overrides of ``info``'s method ``name`` (virtual
+        dispatch: a base-class call site can land in any of them)."""
+        out = []
+        for sub in self.subclasses.get(info.key, []):
+            m = sub.methods.get(name)
+            if m is not None:
+                out.append(m)
+        return out
+
+    def resolve_call(self, call: ast.Call, fi: FuncInfo) -> list[FuncInfo]:
+        fn = call.func
+        # Class(...) / func(...) by bare name
+        if isinstance(fn, ast.Name):
+            mf = self.module_funcs.get(fi.module, {}).get(fn.id)
+            if mf is not None:
+                return [mf]
+            for cls_info in self._class_candidates(fn.id, fi.module):
+                init = self._method_in_mro(cls_info, "__init__")
+                return [init] if init is not None else []
+            imp = self.imports.get(fi.module, {}).get(fn.id)
+            if imp is not None and imp[0] == "sym":
+                tgt = self.module_funcs.get(imp[1], {}).get(imp[2])
+                if tgt is not None:
+                    return [tgt]
+            return []
+        if not isinstance(fn, ast.Attribute):
+            return []
+        recv = fn.value
+        # super().meth(...)
+        if isinstance(recv, ast.Call) and isinstance(recv.func, ast.Name) \
+                and recv.func.id == "super" and fi.cls is not None:
+            own = self.module_classes.get(fi.module, {}).get(fi.cls)
+            if own is not None:
+                m = self._method_in_mro(own, fn.attr, skip_self=True)
+                return [m] if m is not None else []
+            return []
+        if isinstance(recv, ast.Name):
+            # self.meth(...) / cls.meth(...)
+            if recv.id in ("self", "cls") and fi.cls is not None:
+                own = self.module_classes.get(fi.module, {}).get(fi.cls)
+                if own is None:
+                    return []
+                out = []
+                m = self._method_in_mro(own, fn.attr)
+                if m is not None:
+                    out.append(m)
+                out.extend(x for x in self._override_targets(own, fn.attr)
+                           if x is not m)
+                return out
+            # module.func(...) through the import table
+            imp = self.imports.get(fi.module, {}).get(recv.id)
+            if imp is not None and imp[0] == "mod":
+                tgt = self.module_funcs.get(imp[1], {}).get(fn.attr)
+                if tgt is not None:
+                    return [tgt]
+                cls_info = self.module_classes.get(imp[1], {}).get(fn.attr)
+                if cls_info is not None:
+                    init = self._method_in_mro(cls_info, "__init__")
+                    return [init] if init is not None else []
+                return []
+            # unknown receiver: private-name fallback with bounded fanout
+            # (the tracker's routed-partition calls: tr._register(...)).
+            # Same-module candidates win outright — a routed call stays
+            # inside its own layer; cross-module name collisions (an obs
+            # helper sharing a tracker method's name) must not splice
+            # unrelated subsystems into the walk.
+            if fn.attr.startswith("_") and not fn.attr.startswith("__"):
+                cands = self.methods_by_name.get(fn.attr, [])
+                local = [c for c in cands if c.module == fi.module]
+                if local:
+                    cands = local
+                if 0 < len(cands) <= FALLBACK_FANOUT:
+                    return list(cands)
+        return []
+
+    def _class_candidates(self, name: str, module: str) -> list[ClassInfo]:
+        local = self.module_classes.get(module, {}).get(name)
+        if local is not None:
+            return [local]
+        imp = self.imports.get(module, {}).get(name)
+        if imp is not None and imp[0] == "sym":
+            tgt = self.module_classes.get(imp[1], {}).get(imp[2])
+            if tgt is not None:
+                return [tgt]
+        return []
+
+    def _resolve_calls(self, qual: str) -> list[tuple[str, ast.Call]]:
+        fi = self.funcs[qual]
+        out = []
+        for call in body_calls(fi.node):
+            for tgt in self.resolve_call(call, fi):
+                out.append((tgt.qual, call))
+        return out
+
+    # -- queries ------------------------------------------------------------
+
+    def edges(self, qual: str) -> list[tuple[str, ast.Call]]:
+        return self._edges.get(qual, [])
+
+    def reachable(self, entries: list[str],
+                  max_depth: int = MAX_DEPTH) -> dict[str, tuple[int, str]]:
+        """BFS from ``entries``: qual -> (depth, parent qual).  Cycle-safe
+        (first visit wins), bounded by ``max_depth`` call edges."""
+        seen: dict[str, tuple[int, str]] = {}
+        dq: deque[tuple[str, int, str]] = deque(
+            (e, 0, "") for e in entries if e in self.funcs)
+        while dq:
+            qual, depth, parent = dq.popleft()
+            if qual in seen:
+                continue
+            seen[qual] = (depth, parent)
+            if depth >= max_depth:
+                continue
+            for tgt, _call in self.edges(qual):
+                if tgt not in seen:
+                    dq.append((tgt, depth + 1, qual))
+        return seen
+
+    def chain(self, reach: dict[str, tuple[int, str]], qual: str) -> list[str]:
+        """Shortest entry->qual call chain (bare names, for evidence)."""
+        out = []
+        while qual:
+            out.append(self.funcs[qual].name if qual in self.funcs else qual)
+            qual = reach.get(qual, (0, ""))[1]
+        return list(reversed(out))

@@ -140,6 +140,21 @@ def load_baseline(path: str | os.PathLike) -> dict[str, str]:
     return out
 
 
+def save_baseline(path: str | os.PathLike,
+                  suppressions: dict[str, str]) -> None:
+    """Write a fingerprint -> justification map back out in the
+    baseline format (the ``--prune`` rewrite: live entries keep their
+    justifications verbatim, stale ones are simply absent)."""
+    doc = {
+        "version": BASELINE_VERSION,
+        "suppressions": [
+            {"fingerprint": fp, "justification": why}
+            for fp, why in sorted(suppressions.items())
+        ],
+    }
+    Path(path).write_text(json.dumps(doc, indent=1) + "\n", encoding="utf-8")
+
+
 def write_baseline(path: str | os.PathLike,
                    findings: list[Finding]) -> None:
     """Emit a baseline covering ``findings`` with TODO justifications.

@@ -1,0 +1,301 @@
+"""``journal-coverage`` — every control-plane mutation is journaled, and
+the replay log's kind catalogue is closed.
+
+The HA contract (doc/ha.md) makes the journal the single source of
+truth: a standby replays it and MUST land on the primary's bytes.  A
+tracker mutation point that forgets its ``self._journal(kind, ...)``
+append diverges the standby *silently* — nothing fails until a failover
+chaos seed happens to cross the un-journaled transition.  Three rules:
+
+* ``journal-unpaired-mutation`` — in ``tracker/tracker.py`` and
+  ``service/service.py``, a function that mutates journaled state
+  (:data:`JOURNALED_ATTRS` — leases, spares, blob version, link flags,
+  sched ring, rank line, admission/partition tables) must reach a
+  ``_journal(...)`` append on the same call path (bounded depth), or
+  every non-exempt caller must.  ``__init__``/``_adopt_state``/
+  ``_restore_jobs`` are exempt: they *consume* the journal.
+* ``journal-kind-unapplied`` — every journaled kind string must have a
+  ``ControlState._apply_<kind>`` handler (rabit_tpu/ha/state.py) or an
+  explicit ``ServiceState`` routing arm (service/state.py).  A kind
+  that falls through to ``_apply_ignore`` replays as a no-op — the
+  record is written, the standby drops it on the floor.
+* ``journal-apply-dead`` — a ``_apply_*`` handler (or an explicit
+  ServiceState routing arm) whose kind is journaled nowhere: rename
+  drift, the producer died and replay silently lost that state.
+
+This is PR 4's registry-closure pattern (event KINDS) applied to the
+replay log, with the pairing check made interprocedural by the shared
+call graph.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+
+from tools.tpulint.callgraph import CallGraph, body_calls
+from tools.tpulint.core import Finding, const_str, const_strs
+
+RULE_UNPAIRED = "journal-unpaired-mutation"
+RULE_UNAPPLIED = "journal-kind-unapplied"
+RULE_DEAD = "journal-apply-dead"
+
+#: control-plane attributes whose mutations must be journaled (the
+#: fields ControlState/ServiceState replay; doc/ha.md, doc/service.md).
+JOURNALED_ATTRS = frozenset({
+    "_leases", "_spares", "_blob", "_link_flags", "_last_ring",
+    "_ranks", "_n_starts", "_shutdown_tasks", "_n_shutdown",
+    "_parts", "_pooled", "_pool_leases",
+})
+
+#: container methods that mutate their receiver.
+MUTATOR_METHODS = frozenset({
+    "append", "add", "pop", "remove", "discard", "update", "clear",
+    "insert", "extend", "setdefault",
+})
+
+#: functions that consume (replay/restore) the journal rather than
+#: producing it — their mutations ARE the journal's contents.
+EXEMPT_FUNCS = frozenset({"__init__", "_adopt_state", "_restore_jobs"})
+
+#: how many call edges a mutation may sit from its _journal append.
+PAIR_DEPTH = 4
+
+_MUTATION_SCOPES = ("tracker/tracker.py", "service/service.py")
+_KIND_SCOPES = _MUTATION_SCOPES + ("ha/journal.py",)
+
+
+def _flat_targets(node: ast.expr):
+    if isinstance(node, (ast.Tuple, ast.List)):
+        for elt in node.elts:
+            yield from _flat_targets(elt)
+    else:
+        yield node
+
+
+def _target_attr(node: ast.expr) -> tuple[str, str] | None:
+    """(receiver name, attr) when this store target mutates a
+    name-receiver attribute (directly or through a subscript)."""
+    if isinstance(node, ast.Subscript):
+        node = node.value
+    if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name):
+        return node.value.id, node.attr
+    return None
+
+
+def attr_mutations(func_node: ast.FunctionDef, tag_method: bool = False):
+    """(receiver, attr, line) for every attribute mutation in the
+    function body (assign/augassign/del/subscript stores, container
+    mutator calls); nested defs excluded.  With ``tag_method=True``
+    yields 4-tuples whose last element marks mutator-METHOD calls
+    (``.append()`` etc. — callers may require the attr to be a known
+    container before trusting those)."""
+    def emit(recv: str, attr: str, line: int, via_method: bool):
+        if tag_method:
+            return recv, attr, line, via_method
+        return recv, attr, line
+
+    stack: list[ast.AST] = list(func_node.body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            continue
+        targets: list[ast.expr] = []
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                targets.extend(_flat_targets(t))
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            targets = [node.target]
+        elif isinstance(node, ast.Delete):
+            targets = list(node.targets)
+        elif isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr in MUTATOR_METHODS:
+            hit = _target_attr(node.func.value)
+            if hit is not None:
+                yield emit(hit[0], hit[1], node.lineno, True)
+        for t in targets:
+            hit = _target_attr(t)
+            if hit is not None:
+                yield emit(hit[0], hit[1], node.lineno, False)
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _journals_directly(func_node: ast.FunctionDef) -> bool:
+    for call in body_calls(func_node):
+        fn = call.func
+        name = (fn.attr if isinstance(fn, ast.Attribute)
+                else fn.id if isinstance(fn, ast.Name) else "")
+        if name == "_journal":
+            return True
+    return False
+
+
+def _journal_kind_calls(func_node: ast.FunctionDef):
+    """(kind, line) for _journal("k", ...) / put_journal_frame("k", ...)
+    appends with a constant kind."""
+    for call in body_calls(func_node):
+        fn = call.func
+        name = (fn.attr if isinstance(fn, ast.Attribute)
+                else fn.id if isinstance(fn, ast.Name) else "")
+        if name in ("_journal", "put_journal_frame") and call.args:
+            s = const_str(call.args[0])
+            if s is not None:
+                yield s, call.lineno
+
+
+def check_journal(graph: CallGraph, root: Path) -> list[Finding]:
+    findings: list[Finding] = []
+    findings += _check_pairing(graph)
+    findings += _check_closure(graph)
+    return findings
+
+
+# -- mutation <-> _journal pairing -------------------------------------------
+
+def _reaches_journal(graph: CallGraph, qual: str) -> bool:
+    reach = graph.reachable([qual], max_depth=PAIR_DEPTH)
+    return any(_journals_directly(graph.funcs[q].node)
+               for q in reach if q in graph.funcs)
+
+
+def _check_pairing(graph: CallGraph) -> list[Finding]:
+    findings: list[Finding] = []
+    scoped = [fi for fi in graph.funcs.values()
+              if any(fi.module.endswith(s) for s in _MUTATION_SCOPES)]
+    if not scoped:
+        return findings
+    callers: dict[str, list[str]] = {}
+    for qual in graph.funcs:
+        for tgt, _call in graph.edges(qual):
+            callers.setdefault(tgt, []).append(qual)
+    for fi in sorted(scoped, key=lambda f: (f.module, f.node.lineno)):
+        if fi.name in EXEMPT_FUNCS:
+            continue
+        muts = [(attr, line) for _recv, attr, line
+                in attr_mutations(fi.node) if attr in JOURNALED_ATTRS]
+        if not muts:
+            continue
+        if _reaches_journal(graph, fi.qual):
+            continue
+        calling = callers.get(fi.qual, [])
+        live_callers = [q for q in calling
+                        if graph.funcs[q].name not in EXEMPT_FUNCS]
+        if calling and all(
+                graph.funcs[q].name in EXEMPT_FUNCS
+                or _reaches_journal(graph, q) for q in calling) \
+                and live_callers:
+            continue  # every live caller journals around this helper
+        attr, line = min(muts, key=lambda m: m[1])
+        short = f"{fi.cls}.{fi.name}" if fi.cls else fi.name
+        findings.append(Finding(
+            rule=RULE_UNPAIRED,
+            path=fi.module,
+            line=line,
+            message=(f"{short} mutates journaled state {attr!r} with no "
+                     f"self._journal(...) append on the path — a warm "
+                     f"standby replaying the journal diverges silently "
+                     f"here (doc/ha.md)"),
+            token=f"{short}:{attr}",
+        ))
+    return findings
+
+
+# -- kind catalogue closure ---------------------------------------------------
+
+def _collect_kinds(graph: CallGraph):
+    """journaled kinds: kind -> (module, line) of first append."""
+    out: dict[str, tuple[str, int]] = {}
+    for fi in sorted(graph.funcs.values(),
+                     key=lambda f: (f.module, f.node.lineno)):
+        if not any(fi.module.endswith(s) for s in _KIND_SCOPES):
+            continue
+        for kind, line in _journal_kind_calls(fi.node):
+            out.setdefault(kind, (fi.module, line))
+    return out
+
+
+def _collect_handlers(graph: CallGraph):
+    """_apply_<kind> handlers: kind -> (module, line)."""
+    out: dict[str, tuple[str, int]] = {}
+    for fi in graph.funcs.values():
+        if not fi.module.endswith("ha/state.py") or fi.cls is None:
+            continue
+        if fi.name.startswith("_apply_") and fi.name != "_apply_ignore":
+            out[fi.name[len("_apply_"):]] = (fi.module, fi.node.lineno)
+    return out
+
+
+def _collect_service_routed(graph: CallGraph):
+    """kinds ServiceState routes explicitly: kind -> (module, line)
+    (string compares against ``kind`` plus *KINDS tuple literals)."""
+    out: dict[str, tuple[str, int]] = {}
+    for module, tree in graph.trees.items():
+        if not module.endswith("service/state.py"):
+            continue
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Compare):
+                sides = [node.left, *node.comparators]
+                if not any(isinstance(s, ast.Name) and s.id == "kind"
+                           for s in sides):
+                    continue
+                for s in sides:
+                    k = const_str(s)
+                    if k is not None:
+                        out.setdefault(k, (module, node.lineno))
+                for _op, comp in zip(node.ops, node.comparators):
+                    for k in const_strs(comp):
+                        out.setdefault(k, (module, node.lineno))
+            elif isinstance(node, ast.Assign):
+                names = [t.id for t in node.targets
+                         if isinstance(t, ast.Name)]
+                if any(n.endswith("KINDS") for n in names):
+                    for k in const_strs(node.value):
+                        out.setdefault(k, (module, node.lineno))
+    return out
+
+
+def _check_closure(graph: CallGraph) -> list[Finding]:
+    findings: list[Finding] = []
+    kinds = _collect_kinds(graph)
+    handlers = _collect_handlers(graph)
+    routed = _collect_service_routed(graph)
+    if not kinds and not handlers:
+        return findings  # tree has no journal surface at all
+    for kind, (module, line) in sorted(kinds.items()):
+        if kind not in handlers and kind not in routed:
+            findings.append(Finding(
+                rule=RULE_UNAPPLIED,
+                path=module,
+                line=line,
+                message=(f"journaled kind {kind!r} has no "
+                         f"ControlState._apply_{kind} handler and no "
+                         f"ServiceState routing arm — the record is "
+                         f"written but replays as a no-op, so a "
+                         f"standby silently loses this state"),
+                token=f"kind:{kind}",
+            ))
+    for kind, (module, line) in sorted(handlers.items()):
+        if kind not in kinds:
+            findings.append(Finding(
+                rule=RULE_DEAD,
+                path=module,
+                line=line,
+                message=(f"_apply_{kind} has no producer: nothing "
+                         f"journals kind {kind!r} — rename drift, and "
+                         f"replay silently lost whatever state this "
+                         f"handler folded"),
+                token=f"handler:{kind}",
+            ))
+    for kind, (module, line) in sorted(routed.items()):
+        if kind not in kinds and kind not in handlers:
+            findings.append(Finding(
+                rule=RULE_DEAD,
+                path=module,
+                line=line,
+                message=(f"ServiceState routes kind {kind!r} which is "
+                         f"journaled nowhere — dead routing arm"),
+                token=f"routed:{kind}",
+            ))
+    return findings

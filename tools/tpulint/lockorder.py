@@ -1,0 +1,218 @@
+"""``lock-order`` — whole-repo lock-acquisition graph.
+
+Two rules:
+
+* ``lock-order-cycle`` — build the directed graph "holding lock A,
+  acquires lock B" over every ``with <lock>:`` in the tree (lexical
+  nesting PLUS acquisitions made by call-graph-resolved callees, a few
+  edges deep) and flag every cycle.  A cycle is a potential deadlock;
+  a self-edge on a non-reentrant ``threading.Lock`` is a *guaranteed*
+  one — this is the machine-checked version of the ``*_locked`` naming
+  convention (a helper suffixed ``_locked`` is called WITH the lock
+  held and must not re-acquire it).
+* ``lock-across-reactor-wait`` — a ``with <lock>:`` body that calls
+  ``<selector>.select(...)`` holds the lock across a reactor-loop
+  iteration boundary: every other thread that needs the lock (lease
+  ticks, wave completers, relay folds) now waits on *network quiet*,
+  not on a critical section.  The reactor loops take their locks
+  inside the iteration, never around it.
+
+Lock identity: ``self._x`` resolves to the class that assigns it in
+``__init__`` (through the MRO — a ``CollectiveService`` method's
+``self._lock`` is ``Tracker._lock``); a foreign receiver's attr
+(``part._lock``) resolves when exactly one indexed class defines it,
+else it stays a name bucket (``*._lock``).  ``threading.RLock``
+assignments are remembered: re-entry on an RLock is not a self-cycle.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+
+from tools.tpulint.callgraph import CallGraph, ClassInfo, FuncInfo
+from tools.tpulint.core import Finding
+
+RULE_CYCLE = "lock-order-cycle"
+RULE_ACROSS = "lock-across-reactor-wait"
+
+#: how many call edges deep a callee's acquisitions count as "acquired
+#: while holding" (nested helpers stay shallow by design).
+INTER_DEPTH = 3
+
+
+def _lockish(expr: ast.expr) -> ast.expr | None:
+    if isinstance(expr, ast.Attribute) and "lock" in expr.attr.lower():
+        return expr
+    if isinstance(expr, ast.Name) and "lock" in expr.id.lower():
+        return expr
+    return None
+
+
+class _LockId:
+    __slots__ = ("key", "reentrant")
+
+    def __init__(self, key: str, reentrant: bool = False):
+        self.key = key
+        self.reentrant = reentrant
+
+
+def _own_class(graph: CallGraph, fi: FuncInfo) -> ClassInfo | None:
+    if fi.cls is None:
+        return None
+    return graph.module_classes.get(fi.module, {}).get(fi.cls)
+
+
+def _resolve_lock(graph: CallGraph, fi: FuncInfo,
+                  expr: ast.expr) -> _LockId:
+    if isinstance(expr, ast.Name):
+        return _LockId(f"{fi.module}:{expr.id}")
+    assert isinstance(expr, ast.Attribute)
+    attr = expr.attr
+    if isinstance(expr.value, ast.Name) and expr.value.id in ("self", "cls"):
+        own = _own_class(graph, fi)
+        if own is not None:
+            for c in graph.mro(own):
+                if attr in c.init_attrs:
+                    return _LockId(f"{c.name}.{attr}",
+                                   attr in c.rlock_attrs)
+        return _LockId(f"{fi.cls}.{attr}")
+    owners = [c for c in graph.classes.values() if attr in c.init_attrs]
+    if len(owners) == 1:
+        return _LockId(f"{owners[0].name}.{attr}",
+                       attr in owners[0].rlock_attrs)
+    return _LockId(f"*.{attr}")
+
+
+class _Acquisitions:
+    """Per-function lexical lock facts: every acquisition, every
+    (held lock -> acquired lock) nested pair, every call made under a
+    lock, and select() calls under a lock."""
+
+    def __init__(self) -> None:
+        self.acquired: set[str] = set()
+        self.reentrant: set[str] = set()
+        self.nested: list[tuple[str, str, int]] = []     # (held, got, line)
+        self.calls_under: list[tuple[str, ast.Call]] = []
+        self.selects_under: list[tuple[str, int]] = []
+
+
+def _scan(graph: CallGraph, fi: FuncInfo) -> _Acquisitions:
+    acq = _Acquisitions()
+
+    def visit(nodes, stack: list[str]) -> None:
+        for node in nodes:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda, ast.ClassDef)):
+                continue
+            if isinstance(node, ast.With):
+                got = []
+                for item in node.items:
+                    expr = _lockish(item.context_expr)
+                    if expr is None:
+                        continue
+                    lid = _resolve_lock(graph, fi, expr)
+                    acq.acquired.add(lid.key)
+                    if lid.reentrant:
+                        acq.reentrant.add(lid.key)
+                    if stack:
+                        acq.nested.append((stack[-1], lid.key, node.lineno))
+                    got.append(lid.key)
+                visit(node.body, stack + got)
+                continue
+            if isinstance(node, ast.Call) and stack:
+                acq.calls_under.append((stack[-1], node))
+                fn = node.func
+                if isinstance(fn, ast.Attribute) and fn.attr == "select":
+                    acq.selects_under.append((stack[-1], node.lineno))
+            visit(list(ast.iter_child_nodes(node)), stack)
+
+    visit(fi.node.body, [])
+    return acq
+
+
+def check_lock_order(graph: CallGraph, root: Path) -> list[Finding]:
+    scans = {qual: _scan(graph, fi) for qual, fi in graph.funcs.items()}
+    reentrant = set().union(*(s.reentrant for s in scans.values())) \
+        if scans else set()
+
+    def trans_acquired(qual: str) -> set[str]:
+        out: set[str] = set()
+        for q in graph.reachable([qual], max_depth=INTER_DEPTH):
+            if q in scans:
+                out |= scans[q].acquired
+        return out
+
+    # edge: held -> acquired, with one evidence site per edge
+    edges: dict[str, dict[str, tuple[str, int]]] = {}
+    findings: list[Finding] = []
+    for qual, fi in sorted(graph.funcs.items()):
+        scan = scans[qual]
+        for held, got, line in scan.nested:
+            edges.setdefault(held, {}).setdefault(got, (fi.module, line))
+        for held, call in scan.calls_under:
+            for tgt in graph.resolve_call(call, fi):
+                for got in sorted(trans_acquired(tgt.qual)):
+                    edges.setdefault(held, {}).setdefault(
+                        got, (fi.module, call.lineno))
+        for held, line in scan.selects_under:
+            findings.append(Finding(
+                rule=RULE_ACROSS,
+                path=fi.module,
+                line=line,
+                message=(f"selector .select() called while holding "
+                         f"{held} (in {fi.name}): the lock is held "
+                         f"across a reactor-loop iteration boundary, so "
+                         f"every other holder waits on network quiet"),
+                token=f"{fi.name}:{held}:select",
+            ))
+
+    # cycles: self-edges on non-reentrant locks + multi-lock SCCs
+    for held, outs in sorted(edges.items()):
+        if held in outs and held not in reentrant:
+            module, line = outs[held]
+            findings.append(Finding(
+                rule=RULE_CYCLE, path=module, line=line,
+                message=(f"{held} re-acquired while already held — a "
+                         f"threading.Lock is not reentrant; this path "
+                         f"self-deadlocks the moment it runs"),
+                token=f"cycle:{held}"))
+    for cycle in _cycles(edges):
+        # anchor at the latest edge site in the cycle (the "back edge")
+        sites = []
+        for a, b in zip(cycle, cycle[1:] + cycle[:1]):
+            sites.append(edges[a][b])
+        module, line = max(sites)
+        order = " -> ".join(cycle + [cycle[0]])
+        findings.append(Finding(
+            rule=RULE_CYCLE, path=module, line=line,
+            message=(f"lock-acquisition cycle {order}: two threads "
+                     f"taking these locks in opposite order deadlock"),
+            token="cycle:" + "->".join(sorted(cycle))))
+    return findings
+
+
+def _cycles(edges: dict[str, dict[str, tuple]]) -> list[list[str]]:
+    """Distinct simple cycles of length >= 2 (one representative per
+    node set), via DFS from each node in sorted order."""
+    out: list[list[str]] = []
+    seen_sets: set[frozenset] = set()
+
+    def dfs(start: str, node: str, path: list[str],
+            on_path: set[str]) -> None:
+        for nxt in sorted(edges.get(node, ())):
+            if nxt == start and len(path) >= 2:
+                key = frozenset(path)
+                if key not in seen_sets:
+                    seen_sets.add(key)
+                    out.append(list(path))
+            elif nxt not in on_path and nxt > start:
+                # only walk nodes > start: each cycle is found once,
+                # rooted at its smallest node
+                on_path.add(nxt)
+                dfs(start, nxt, path + [nxt], on_path)
+                on_path.discard(nxt)
+
+    for start in sorted(edges):
+        dfs(start, start, [start], {start})
+    return out

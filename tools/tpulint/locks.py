@@ -33,31 +33,10 @@ from __future__ import annotations
 import ast
 from pathlib import Path
 
+from tools.tpulint.blocking import blocking_reason
 from tools.tpulint.core import Finding, parse_python, rel
 
 RULE = "lock-blocking-call"
-
-#: Attribute names that block regardless of receiver (socket/file/thread
-#: shaped).  ``join`` is deliberately absent: ``str.join`` would swamp the
-#: signal; thread joins under a lock are caught via ``wait``/helpers.
-_BLOCKING_ATTRS = frozenset({
-    "recv", "recv_into", "recvfrom", "recv_exact",
-    "send", "sendall", "sendto",
-    "accept", "connect", "connect_ex",
-    "wait", "communicate",
-    "read_bytes", "write_bytes", "read_text", "write_text",
-})
-
-#: module-level calls: {module name: attrs} (None = every attr blocks).
-_BLOCKING_MODULE_ATTRS: dict[str, frozenset | None] = {
-    "subprocess": None,
-    "time": frozenset({"sleep"}),
-    "socket": frozenset({"create_connection", "getaddrinfo"}),
-    "os": frozenset({"fsync"}),
-}
-
-#: bare-name calls that block.
-_BLOCKING_NAMES = frozenset({"open", "sleep", "tracker_rpc"})
 
 
 def _lockish(expr: ast.expr) -> str | None:
@@ -73,22 +52,9 @@ def _lockish(expr: ast.expr) -> str | None:
     return None
 
 
-def _blocking_call(call: ast.Call) -> str | None:
-    """Describe why this call blocks, else None."""
-    fn = call.func
-    if isinstance(fn, ast.Attribute):
-        if (isinstance(fn.value, ast.Name)
-                and fn.value.id in _BLOCKING_MODULE_ATTRS):
-            allowed = _BLOCKING_MODULE_ATTRS[fn.value.id]
-            if allowed is None or fn.attr in allowed:
-                return f"{fn.value.id}.{fn.attr}"
-        if fn.attr in _BLOCKING_ATTRS:
-            return f".{fn.attr}"
-        if fn.attr == "tracker_rpc":
-            return "tracker_rpc"
-    elif isinstance(fn, ast.Name) and fn.id in _BLOCKING_NAMES:
-        return fn.id
-    return None
+#: locks.py's classifier is the shared one, with NO exemptions: even a
+#: bounded wait under a shared lock stalls every other holder.
+_blocking_call = blocking_reason
 
 
 def _body_calls(nodes: list[ast.stmt]):

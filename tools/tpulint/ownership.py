@@ -1,0 +1,161 @@
+"""``thread-shared-mutation`` — cross-thread tracker state must be
+lock-protected.
+
+PR 12 made the sharing explicit: partition state lives on objects
+touched concurrently by the reactor loop, the relay channel threads,
+the monitor tick pair (``_lease_tick``/``_wave_tick``) and the wave
+completer.  An unprotected mutation on any of those paths is a data
+race whose symptom is a lost lease, a double-sent wave, or a torn
+pending list — never an exception.
+
+The analyzer assigns every function in ``tracker/tracker.py`` /
+``service/service.py`` to the THREAD CONTEXTS it is reachable from
+(shared call graph, subclass overrides included):
+
+* ``reactor`` — the selectors loop and its handlers;
+* ``relay-channel`` — ``_serve_relay``/``_fold_batch_msg`` (one thread
+  per relay channel, concurrent with everything);
+* ``monitor`` — the lease/wave tick pair (one thread each, and a
+  CollectiveService ticks every partition from them);
+* ``completer`` — ``_send_wave`` (spawned per closed wave).
+
+For every ``self.<attr>`` access on an instance attribute it then
+checks: if the attribute is touched from two or more distinct contexts
+and ANY in-context mutation happens outside a ``with <lock>:`` body
+(and outside a ``*_locked`` function — the "caller holds the lock"
+convention), that mutation is flagged.  Lock attributes themselves and
+``threading.Event`` signal methods are not mutations; accesses through
+non-``self`` receivers (``part._pending`` under ``part._lock``) are out
+of scope — the partition helpers that do this take the right lock
+lexically, which IS the pattern this rule enforces.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+
+from tools.tpulint.callgraph import CallGraph, FuncInfo
+from tools.tpulint.core import Finding
+from tools.tpulint.journalcov import attr_mutations
+
+RULE = "thread-shared-mutation"
+
+_SCOPES = ("tracker/tracker.py", "service/service.py")
+
+#: thread-context roots, matched by method name within the scope files.
+CONTEXT_ROOTS: dict[str, frozenset] = {
+    "reactor": frozenset({
+        "_serve_reactor", "_reactor_accept", "_reactor_read",
+        "_reactor_flush", "_reactor_drop",
+    }),
+    "relay-channel": frozenset({"_serve_relay", "_fold_batch_msg"}),
+    "monitor": frozenset({
+        "_lease_monitor", "_wave_monitor", "_lease_tick", "_wave_tick",
+        "note_dead",
+    }),
+    "completer": frozenset({"_send_wave"}),
+}
+
+#: construction/restore functions: the object is not shared yet (the
+#: serving threads that could race do not exist), so their assignments
+#: are initialization, not cross-thread mutation.
+EXEMPT_FUNCS = frozenset({"__init__", "_adopt_state", "_restore_jobs"})
+
+
+def _scope_funcs(graph: CallGraph) -> list[FuncInfo]:
+    return [fi for fi in graph.funcs.values()
+            if any(fi.module.endswith(s) for s in _SCOPES)]
+
+
+def _contexts_by_qual(graph: CallGraph) -> dict[str, set]:
+    out: dict[str, set] = {}
+    for ctx, names in CONTEXT_ROOTS.items():
+        roots = [fi.qual for fi in _scope_funcs(graph) if fi.name in names]
+        for qual in graph.reachable(roots):
+            out.setdefault(qual, set()).add(ctx)
+    return out
+
+
+def _self_accesses(fi: FuncInfo):
+    """(attr, line, under_lock) for every ``self.<attr>`` access, with
+    the lexical with-lock state (any lock counts); nested defs
+    excluded."""
+    def lockish(expr: ast.expr) -> bool:
+        name = (expr.attr if isinstance(expr, ast.Attribute)
+                else expr.id if isinstance(expr, ast.Name) else "")
+        return "lock" in name.lower()
+
+    out: list[tuple[str, int, bool]] = []
+
+    def visit(nodes, locked: bool) -> None:
+        for node in nodes:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda, ast.ClassDef)):
+                continue
+            if isinstance(node, ast.With):
+                here = locked or any(lockish(i.context_expr)
+                                     for i in node.items)
+                for item in node.items:
+                    visit([item.context_expr], locked)
+                visit(node.body, here)
+                continue
+            if isinstance(node, ast.Attribute) \
+                    and isinstance(node.value, ast.Name) \
+                    and node.value.id == "self":
+                out.append((node.attr, node.lineno, locked))
+            visit(list(ast.iter_child_nodes(node)), locked)
+
+    visit(fi.node.body, False)
+    return out
+
+
+def check_ownership(graph: CallGraph, root: Path) -> list[Finding]:
+    contexts = _contexts_by_qual(graph)
+    # per (owner class key, attr): contexts touching it + unprotected
+    # in-context mutations
+    touched: dict[tuple[str, str], set] = {}
+    unprotected: dict[tuple[str, str], list[tuple[str, int, str]]] = {}
+    for fi in sorted(_scope_funcs(graph),
+                     key=lambda f: (f.module, f.node.lineno)):
+        ctxs = contexts.get(fi.qual)
+        if not ctxs or fi.cls is None or fi.name in EXEMPT_FUNCS:
+            continue
+        own = graph.module_classes.get(fi.module, {}).get(fi.cls)
+        if own is None:
+            continue
+        mro = graph.mro(own)
+        containers = set().union(*(c.container_attrs for c in mro))
+        mut_lines = {(attr, line) for recv, attr, line, via_method
+                     in attr_mutations(fi.node, tag_method=True)
+                     if recv == "self"
+                     and (not via_method or attr in containers)}
+        convention = fi.name.endswith("_locked")
+        for attr, line, locked in _self_accesses(fi):
+            if "lock" in attr.lower():
+                continue
+            owner = next((c for c in mro if attr in c.init_attrs), None)
+            if owner is None:
+                continue  # not instance state (methods, class attrs)
+            key = (owner.name, attr)
+            touched.setdefault(key, set()).update(ctxs)
+            if (attr, line) in mut_lines and not locked and not convention:
+                unprotected.setdefault(key, []).append(
+                    (fi.module, line, fi.name))
+    findings: list[Finding] = []
+    for key in sorted(unprotected):
+        if len(touched.get(key, set())) < 2:
+            continue  # single-context state: that thread owns it
+        owner, attr = key
+        module, line, fname = min(unprotected[key])
+        ctxs = ", ".join(sorted(touched[key]))
+        findings.append(Finding(
+            rule=RULE,
+            path=module,
+            line=line,
+            message=(f"{owner}.{attr} is shared across thread contexts "
+                     f"({ctxs}) but mutated without a lock in {fname} — "
+                     f"protect it or justify why the race is benign"),
+            token=f"{owner}.{attr}",
+        ))
+    return findings

@@ -1,0 +1,97 @@
+"""``reactor-blocking`` — no blocking call reachable from a reactor
+callback.
+
+The hazard got concrete with the service (doc/service.md): EVERY job's
+short RPCs — heartbeats included — are answered by ONE selectors loop,
+and the relay batch fold serializes every child of a relay.  A single
+reachable blocking call (an untimed socket op, ``time.sleep``, file IO,
+a ``tracker_rpc`` round-trip) therefore no longer stalls one worker's
+handler thread: it freezes every tenant of the control plane at once.
+
+Entry points (matched by METHOD NAME inside the owning module, so
+subclass overrides and fixture trees are covered):
+
+* ``rabit_tpu/tracker/tracker.py`` — the reactor loop and its
+  EVENT_READ/EVENT_WRITE handlers (``_serve_reactor``,
+  ``_reactor_accept``, ``_reactor_read``, ``_reactor_flush``,
+  ``_reactor_drop``) plus the relay batch fold (``_fold_batch_msg`` —
+  it runs on the channel thread, but a blocking call there stalls every
+  child of that relay, and through ``_route_hello`` it reaches the same
+  dispatch surface).  ``_serve_relay`` itself is deliberately NOT an
+  entry: its framed-read loop IS the channel thread's design blocking
+  point.
+* ``rabit_tpu/relay/__init__.py`` — the relay's child reactor
+  (``_serve_children``, ``_accept_children``, ``_child_read``,
+  ``_child_flush``, ``_dispatch_child``).
+
+From each entry the analyzer walks the shared call graph
+(``callgraph.MAX_DEPTH`` edges: ``self.``/super resolution, subclass
+overrides — the service's ``_route_hello`` — and bounded private-name
+fallback for routed-partition calls like ``tr._register``) and flags
+every blocking call in every reached function, with the shortest call
+chain as evidence.  Exemptions (tools/tpulint/blocking.py): calls
+guarded by ``except BlockingIOError`` (non-blocking sockets),
+``MSG_DONTWAIT``/``MSG_PEEK`` recv flags, and timeout-bounded waits.
+``threading.Thread(target=...)`` hand-offs are not call edges — handing
+work to a thread is the fix, not the bug.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+from tools.tpulint.blocking import iter_blocking_calls
+from tools.tpulint.callgraph import CallGraph
+from tools.tpulint.core import Finding
+
+RULE = "reactor-blocking"
+
+#: entry method names per module suffix (any class, any override).
+ENTRY_METHODS: dict[str, frozenset] = {
+    "tracker/tracker.py": frozenset({
+        "_serve_reactor", "_reactor_accept", "_reactor_read",
+        "_reactor_flush", "_reactor_drop", "_fold_batch_msg",
+    }),
+    "relay/__init__.py": frozenset({
+        "_serve_children", "_accept_children", "_child_read",
+        "_child_flush", "_dispatch_child",
+    }),
+}
+
+
+def entry_quals(graph: CallGraph) -> list[str]:
+    out = []
+    for qual, fi in graph.funcs.items():
+        for suffix, names in ENTRY_METHODS.items():
+            if fi.module.endswith(suffix) and fi.name in names:
+                out.append(qual)
+    return sorted(out)
+
+
+def check_reactor(graph: CallGraph, root: Path) -> list[Finding]:
+    entries = entry_quals(graph)
+    reach = graph.reachable(entries)
+    findings: list[Finding] = []
+    seen_tokens: set[str] = set()
+    for qual in sorted(reach, key=lambda q: reach[q][0]):
+        fi = graph.funcs.get(qual)
+        if fi is None:
+            continue
+        short = f"{fi.cls}.{fi.name}" if fi.cls else fi.name
+        for call, why in iter_blocking_calls(fi.node):
+            token = f"{short}:{why}"
+            if token in seen_tokens:
+                continue
+            seen_tokens.add(token)
+            chain = " -> ".join(graph.chain(reach, qual))
+            findings.append(Finding(
+                rule=RULE,
+                path=fi.module,
+                line=call.lineno,
+                message=(f"blocking call {why} reachable from reactor "
+                         f"entry ({chain}); a stall here freezes every "
+                         f"tenant served by this loop — hand the work "
+                         f"to a thread or bound it"),
+                token=token,
+            ))
+    return findings
