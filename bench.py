@@ -126,6 +126,14 @@ FUSED_BENCH = os.environ.get("RABIT_BENCH_FUSED", "1") != "0"
 # other riders; RABIT_BENCH_SERVICE=0 skips it.
 SERVICE_BENCH = os.environ.get("RABIT_BENCH_SERVICE", "1") != "0"
 SERVICE_CHILD_TIMEOUT = 180.0
+# Live telemetry plane (ISSUE 16): one CMD_OBS scrape taken MID-RUN of a
+# real 2-rank elastic job (``--obs-worker``; doc/observability.md "Live
+# telemetry plane") — scrape latency, fold/link evidence, and the
+# streamed-delta round trip, so every driver record carries live-plane
+# evidence alongside device_probe.  ~5s, deducted from the TPU budget
+# like the other riders; RABIT_BENCH_OBS=0 skips it.
+OBS_BENCH = os.environ.get("RABIT_BENCH_OBS", "1") != "0"
+OBS_CHILD_TIMEOUT = 90.0
 FUSED_CHILD_TIMEOUT = 180.0
 FUSED_WORLD = 4
 FUSED_ELEMS = 1 << 18  # 1 MiB of f32 — the acceptance bar's payload floor
@@ -560,7 +568,8 @@ def run_service_bench(timeout=SERVICE_CHILD_TIMEOUT):
     driver).  Returns the record list, empty on timeout/failure."""
     cmd = [sys.executable,
            os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                        "tools", "service_bench.py"), "--smoke"]
+                        "tools", "service_bench.py"), "--smoke",
+           "--observed"]
     lines = []
     try:
         r = subprocess.run(cmd, timeout=timeout, capture_output=True,
@@ -577,6 +586,109 @@ def run_service_bench(timeout=SERVICE_CHILD_TIMEOUT):
             log(f"service bench child rc={r.returncode}")
     except subprocess.TimeoutExpired:
         log(f"service bench child timed out after {timeout:.0f}s")
+    return lines
+
+
+def obs_worker():
+    """Child (no jax): live telemetry plane smoke.  A real 2-rank elastic
+    run against an in-thread tracker; while the round is still running the
+    driver takes ONE ``CMD_OBS`` scrape (rabit_tpu.obs.top.scrape), after
+    shipping the global registry's streamed-metric delta window the
+    workers produced so far — the full worker->tracker->scrape loop, live,
+    not post-hoc.  Prints one ``{"bench": "live_metrics"}`` JSON line."""
+    from rabit_tpu.elastic.client import ElasticWorker
+    from rabit_tpu.obs import stream as obs_stream
+    from rabit_tpu.obs.top import scrape
+    from rabit_tpu.tracker import protocol as TP
+    from rabit_tpu.tracker.tracker import Tracker
+
+    # ~30 rounds x 50ms keeps the job alive for seconds: a finished plain
+    # tracker stops serving, so the scrape must land genuinely mid-run.
+    world, niter = 2, 30
+    tracker = Tracker(world_size=world, quiet=True).start()
+    src = obs_stream.DeltaSource()  # the run streams into the global registry
+    results = {}
+
+    def contribution(v, w, r):
+        time.sleep(0.05)
+        return np.full(8, v * (r + 1), np.int64)
+
+    def run(i):
+        w = ElasticWorker((tracker.host, tracker.port), str(i), contribution,
+                          niter, deadline_sec=60.0, rpc_timeout=2.0,
+                          wave_timeout=20.0)
+        results[i] = w.run()
+
+    threads = [threading.Thread(target=run, args=(i,), daemon=True)
+               for i in range(world)]
+    for t in threads:
+        t.start()
+    time.sleep(0.5)  # mid-run: rounds are still in flight
+    alive_at_scrape = sum(t.is_alive() for t in threads)
+    delta = src.take()
+    shipped = False
+    if delta is not None:
+        snap = {"schema": 1, "rank": 0, "task_id": "0", "counters": {},
+                "histograms": {}, "delta": delta}
+        try:
+            shipped = TP.tracker_rpc(
+                tracker.host, tracker.port, TP.CMD_METRICS, "0",
+                message=json.dumps(snap), timeout=5.0, retries=1) == TP.ACK
+        except (TP.TrackerUnreachable, ValueError):
+            shipped = False
+    t0 = time.perf_counter()
+    doc = scrape(tracker.host, tracker.port)
+    scrape_ms = (time.perf_counter() - t0) * 1e3
+    for t in threads:
+        t.join(timeout=90)
+    completed = len(results) == world and all(
+        getattr(r, "completed", False) for r in results.values())
+    tracker.stop()
+    job = doc.get("jobs", {}).get("", {})
+    rolled = job.get("stream", {})
+    line = {
+        "bench": "live_metrics",
+        "schema": doc.get("schema"),
+        "scrape_ms": round(scrape_ms, 3),
+        "workers_alive_at_scrape": alive_at_scrape,
+        "world": job.get("world"),
+        "epoch": job.get("epoch"),
+        "delta_shipped": shipped,
+        "n_folds": rolled.get("n_folds", 0),
+        "links": len(rolled.get("links", [])),
+        "wire_bytes": obs_stream.wire_bytes_by_codec(
+            rolled.get("total", {"counters": {}})),
+        "completed": completed,
+    }
+    log(f"live_metrics: scrape {scrape_ms:.1f} ms mid-run "
+        f"({alive_at_scrape} workers live, {line['n_folds']} fold(s), "
+        f"{line['links']} link(s))")
+    print(json.dumps(line), flush=True)
+
+
+def run_obs_bench(timeout=OBS_CHILD_TIMEOUT):
+    """Live-telemetry scrape evidence (``--obs-worker``) in a child
+    (threads + real sockets; a child so a wedged run cannot stall the
+    driver).  Returns the record list, empty on timeout/failure — the
+    live-plane evidence must never cost the main metric its line."""
+    cmd = [sys.executable, os.path.abspath(__file__), "--obs-worker"]
+    lines = []
+    try:
+        r = subprocess.run(cmd, timeout=timeout, capture_output=True,
+                           text=True)
+        if r.returncode == 0:
+            for line in r.stdout.strip().splitlines():
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if isinstance(rec, dict) and rec.get("bench") == "live_metrics":
+                    lines.append(rec)
+        else:
+            tail = (r.stderr or "").strip().splitlines()[-3:]
+            log(f"live metrics child rc={r.returncode}: {' | '.join(tail)}")
+    except subprocess.TimeoutExpired:
+        log(f"live metrics child timed out after {timeout:.0f}s")
     return lines
 
 
@@ -1076,6 +1188,14 @@ def main():
                          min(tpu_budget, 300.0))
         log(f"service bench: {len(service_lines)} line(s); "
             f"TPU budget now {tpu_budget:.0f}s")
+    obs_lines = []
+    if OBS_BENCH:
+        t_ob = time.time()
+        obs_lines = run_obs_bench()
+        tpu_budget = max(tpu_budget - (time.time() - t_ob),
+                         min(tpu_budget, 300.0))
+        log(f"live metrics bench: {len(obs_lines)} line(s); "
+            f"TPU budget now {tpu_budget:.0f}s")
     probe_daemon = ProbeDaemon().start()
     # start paused: attempt 1 launches immediately and owns the chip; the
     # child's teardown resumes the cadence for the probe-gated retries
@@ -1125,6 +1245,8 @@ def main():
             rec["fused_ab"] = fused_lines
         if service_lines:
             rec["service"] = service_lines
+        if obs_lines:
+            rec["live_metrics"] = obs_lines
         print(json.dumps(rec), flush=True)
         return
     device_time = res["device_time"]
@@ -1187,6 +1309,8 @@ def main():
         rec["fused_ab"] = fused_lines
     if service_lines:
         rec["service"] = service_lines
+    if obs_lines:
+        rec["live_metrics"] = obs_lines
     print(json.dumps(rec), flush=True)
 
 
@@ -1209,6 +1333,8 @@ if __name__ == "__main__":
         if FUSED_BENCH:
             for rec in run_fused_bench():
                 print(json.dumps(rec), flush=True)
+    elif len(sys.argv) > 1 and sys.argv[1] == "--obs-worker":
+        obs_worker()
     elif len(sys.argv) > 1 and sys.argv[1] == "--fused-worker":
         fused_worker(int(sys.argv[2]), int(sys.argv[3]), int(sys.argv[4]))
     elif len(sys.argv) > 1 and sys.argv[1] == "--fused-ab":

@@ -33,6 +33,7 @@ import zlib
 import numpy as np
 
 from rabit_tpu.compress.codecs import DEFLATE_LEVEL, Codec, get_codec
+from rabit_tpu.obs import stream as obs_stream
 from rabit_tpu.obs.metrics import GLOBAL_REGISTRY
 
 #: Wire frame prepended to every rank's allgather slice:
@@ -48,13 +49,21 @@ class CodecMismatchError(RuntimeError):
 
 def observe(codec_name: str, raw: int, wire: int,
             encode_s: float | None = None,
-            decode_s: float | None = None) -> None:
+            decode_s: float | None = None,
+            fused: bool = False) -> None:
     """Record one compression event into the process metrics registry:
     raw/wire byte counters plus per-codec ratio and latency histograms
-    (doc/observability.md, "Compression metrics")."""
+    (doc/observability.md, "Compression metrics").  ``fused=True`` marks
+    bytes moved by the fused in-graph device path (engine/fused.py) —
+    the labeled ``wire_bytes``/``raw_bytes`` series feed the live
+    telemetry plane's (job, codec, fused) accounting."""
     reg = GLOBAL_REGISTRY
     reg.counter("compress_raw_bytes_total").inc(int(raw))
     reg.counter("compress_wire_bytes_total").inc(int(wire))
+    obs_stream.stream_count("wire_bytes", wire, codec=codec_name,
+                            fused=int(bool(fused)))
+    obs_stream.stream_count("raw_bytes", raw, codec=codec_name,
+                            fused=int(bool(fused)))
     if wire > 0:
         reg.histogram(f"compress_ratio_{codec_name}").observe(raw / wire)
     if encode_s is not None:

@@ -113,6 +113,16 @@ DEFAULTS: dict[str, str] = {
     "rabit_obs_capacity": "2048",
     "rabit_obs_hang_sec": "300",
     "rabit_obs_heartbeat_sec": "0",
+    # Live telemetry plane (doc/observability.md "Live telemetry
+    # plane").  rabit_obs_spill_sec > 0: each rank periodically spills
+    # its flight ring into the obs dir so `trace_tool export --follow`
+    # can emit a growing Perfetto file mid-run.  rabit_obs_max_files
+    # caps the obs dir's flight-dump count (oldest-first eviction,
+    # obs_evicted event; 0 disables).  rabit_obs_scrape names the
+    # task id CMD_OBS scrape clients identify as (obs_top, benches).
+    "rabit_obs_spill_sec": "0",
+    "rabit_obs_max_files": "256",
+    "rabit_obs_scrape": "obs",
     # Liveness layer (doc/fault_tolerance.md).  rabit_heartbeat_sec > 0:
     # renew a CMD_HEARTBEAT lease with the tracker every N seconds; the
     # tracker suspects this worker (lease_expired event + on_suspect

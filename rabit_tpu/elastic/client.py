@@ -67,6 +67,7 @@ import numpy as np
 
 from rabit_tpu.elastic.rebalance import refold
 from rabit_tpu.obs.ship import Heartbeat, renew_lease
+from rabit_tpu.obs.stream import stream_observe
 from rabit_tpu.tracker import protocol as P
 
 
@@ -565,6 +566,11 @@ class ElasticWorker:
             wait = time.monotonic() - t0
             self._epoch_wait_s += wait
             self._wait_total_s += wait
+            # Per-planned-link wait histogram for the live telemetry
+            # plane (doc/observability.md): the route-around loop reads
+            # these (src -> dst) health series from the tracker scrape.
+            stream_observe("link_wait_seconds", wait,
+                           src=self._ring_prev, dst=asg.rank)
             # the block s steps behind THIS POSITION in the planned ring
             blocks[self._order[(self._pos - 1 - step) % world]] = incoming
             outgoing = incoming

@@ -330,7 +330,8 @@ class XlaEngine(Engine):
             # codec ratios stay comparable with the host path's meter
             _compress.observe(codec.name, raw=arr.nbytes,
                               wire=codec.wire_len(n),
-                              encode_s=_time.perf_counter() - t0)
+                              encode_s=_time.perf_counter() - t0,
+                              fused=True)
             return result
         encode, fold = self._compressed_fns(op, codec, n)
         t0 = _time.perf_counter()

@@ -55,6 +55,7 @@ from rabit_tpu.obs.metrics import (  # noqa: F401 (re-exports)
     _Span,
 )
 from rabit_tpu.obs import ship as _ship
+from rabit_tpu.obs import stream as _stream
 from rabit_tpu.obs.trace import GLOBAL_CLOCK  # noqa: F401 (re-export)
 
 #: Exit code of the hang-abort escalation (dump-then-die).  Distinct from
@@ -95,6 +96,15 @@ class _ObsState:
         self.tracker: tuple[str, int] | None = None
         self.heartbeat: _ship.Heartbeat | None = None
         self.lease_hb: _ship.Heartbeat | None = None
+        # Live telemetry plane (doc/observability.md): the delta source
+        # diffing successive registry states into the bounded windows
+        # every CMD_METRICS snapshot piggybacks; the periodic flight-ring
+        # spill ticker (rabit_obs_spill_sec) feeding follow-mode trace
+        # export; the flight-dump retention cap (rabit_obs_max_files).
+        self.delta_source = _stream.DeltaSource()
+        self.spill_hb: _ship.Heartbeat | None = None
+        self.spill_sec: float = 0.0
+        self.max_files: int = 256
         self.watchdog_started = False
         self.sigterm_installed = False
         self.prev_sigterm = None
@@ -147,6 +157,7 @@ def configure(config, rank: int = -1) -> None:
     Keys (doc/observability.md, doc/fault_tolerance.md): ``rabit_obs_dir``
     (also the plain ``RABIT_OBS_DIR`` env var), ``rabit_obs_capacity``,
     ``rabit_obs_hang_sec``, ``rabit_obs_heartbeat_sec``,
+    ``rabit_obs_spill_sec``, ``rabit_obs_max_files``,
     ``rabit_hang_abort_sec``, ``rabit_heartbeat_sec``,
     ``rabit_trace_exit``, ``rabit_trace_clock_pings``.
     """
@@ -158,6 +169,8 @@ def configure(config, rank: int = -1) -> None:
     hang_sec = float(config.get("rabit_obs_hang_sec", "300") or "300")
     hang_abort_sec = float(config.get("rabit_hang_abort_sec", "0") or "0")
     heartbeat_sec = float(config.get("rabit_obs_heartbeat_sec", "0") or "0")
+    spill_sec = float(config.get("rabit_obs_spill_sec", "0") or "0")
+    max_files = config.get_int("rabit_obs_max_files", 256)
     lease_sec = float(config.get("rabit_heartbeat_sec", "0") or "0")
     tracker_uri = config.get("rabit_tracker_uri", "NULL")
     task_id = config.get("rabit_task_id", "NULL") or "NULL"
@@ -176,6 +189,13 @@ def configure(config, rank: int = -1) -> None:
         _STATE.task_id = task_id
         _STATE.trace_exit = trace_exit
         _STATE.trace_clock_pings = clock_pings
+        _STATE.spill_sec = spill_sec
+        _STATE.max_files = max_files
+        # Fresh delta baseline: the first window shipped to THIS job's
+        # tracker is the full cumulative state, so the tracker-side fold
+        # reconciles with the cumulative snapshot even when the process
+        # (and its registry) outlives a previous init.
+        _STATE.delta_source = _stream.DeltaSource()
         # fresh init: the cross-rank collective numbering restarts at
         # (version 0, seq 0), exactly like every other first-life rank's
         _STATE.op_version = 0
@@ -212,6 +232,13 @@ def configure(config, rank: int = -1) -> None:
         lhb = _ship.Heartbeat(lease_sec, _renew_lease, immediate=True).start()
         with _STATE.lock:
             _STATE.lease_hb = lhb
+    if spill_sec > 0 and obs_dir:
+        # Periodic flight-ring spill (doc/observability.md "Live
+        # telemetry plane"): follow-mode trace export tails these dumps
+        # mid-run; retention above keeps the dir bounded.
+        shb = _ship.Heartbeat(spill_sec, _spill_tick).start()
+        with _STATE.lock:
+            _STATE.spill_hb = shb
 
 
 # -- collective spans --------------------------------------------------------
@@ -283,6 +310,46 @@ def collective(op: str, nbytes: int, cache_key: str | None = None,
 
 # -- failure-path dumps ------------------------------------------------------
 
+def _evict_flight_dumps(obs_dir: str, max_files: int) -> int:
+    """Oldest-first flight-dump eviction down to ``max_files``
+    (rabit_obs_max_files): the periodic spill must not fill a disk over a
+    long run.  Returns how many files were removed; never raises."""
+    if max_files <= 0:
+        return 0
+    try:
+        names = [n for n in os.listdir(obs_dir)
+                 if n.startswith("flight-") and n.endswith(".jsonl")]
+    except OSError:
+        return 0
+    excess = len(names) - max_files
+    if excess <= 0:
+        return 0
+    stamped = []
+    for n in names:
+        path = os.path.join(obs_dir, n)
+        try:
+            stamped.append((os.path.getmtime(path), path))
+        except OSError:
+            continue
+    stamped.sort()
+    evicted = 0
+    for _mtime, path in stamped[:excess]:
+        try:
+            os.remove(path)
+            evicted += 1
+        except OSError:
+            pass
+    if evicted:
+        record_event("obs_evicted", n=evicted, max_files=max_files)
+    return evicted
+
+
+def _spill_tick() -> None:
+    """One periodic flight-ring spill (rabit_obs_spill_sec): the live
+    evidence follow-mode trace export tails mid-run."""
+    dump_now("spill")
+
+
 def dump_now(reason: str) -> str | None:
     """Dump the flight recorder to the configured obs dir; returns the path
     (None when no dir is configured).  Never raises.
@@ -293,6 +360,7 @@ def dump_now(reason: str) -> str | None:
     with _STATE.lock:
         obs_dir, rank = _STATE.obs_dir, _STATE.rank
         inflight = list(_STATE.inflight.values())
+        max_files = _STATE.max_files
     if not obs_dir:
         return None
     try:
@@ -307,10 +375,12 @@ def dump_now(reason: str) -> str | None:
             obs_dir,
             f"flight-rank{rank}-pid{os.getpid()}-n{seq}-{reason}.jsonl",
         )
-        return GLOBAL_RECORDER.dump(
+        out = GLOBAL_RECORDER.dump(
             path, header={"reason": reason, "rank": rank, "dump_seq": seq,
                           "task_id": _STATE.task_id}
         )
+        _evict_flight_dumps(obs_dir, max_files)
+        return out
     except OSError:
         return None
 
@@ -413,11 +483,18 @@ def _start_hang_watchdog() -> None:
 def _make_snapshot() -> dict:
     with _STATE.lock:
         rank, task_id = _STATE.rank, _STATE.task_id
+        source = _STATE.delta_source
     extra: dict = {"flight_dropped": GLOBAL_RECORDER.dropped}
     clock = GLOBAL_CLOCK.snapshot()
     if clock is not None:
         # this rank's tracker-clock offset estimate (trace.py projection)
         extra["clock"] = clock
+    # Piggyback the streamed-metrics delta window (doc/observability.md
+    # "Live telemetry plane"): the tracker/relay strips it at ingest and
+    # folds it into the live rollup; the snapshot itself stays cumulative.
+    delta = source.take()
+    if delta is not None:
+        extra["delta"] = delta
     return _ship.build_snapshot(GLOBAL_REGISTRY, rank, task_id, extra=extra)
 
 
@@ -452,11 +529,13 @@ def _renew_lease() -> bool:
 
 
 def stop_heartbeat() -> None:
-    """Stop both periodic senders (metric snapshots and lease renewals)."""
+    """Stop every periodic sender (metric snapshots, lease renewals, and
+    the flight-ring spill ticker)."""
     with _STATE.lock:
         hb, _STATE.heartbeat = _STATE.heartbeat, None
         lhb, _STATE.lease_hb = _STATE.lease_hb, None
-    for t in (hb, lhb):
+        shb, _STATE.spill_hb = _STATE.spill_hb, None
+    for t in (hb, lhb, shb):
         if t is not None:
             t.stop()
 

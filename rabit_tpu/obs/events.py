@@ -84,6 +84,15 @@ KINDS: dict[str, str] = {
     "lease_expired": "heartbeat lease lapsed: task_id, rank, overdue",
     "snapshot_rejected": "CMD_METRICS snapshot with out-of-range rank",
     "metrics_snapshot": "CMD_METRICS snapshot accepted: rank, task_id",
+    # live telemetry plane (rabit_tpu/obs/stream.py,
+    # doc/observability.md "Live telemetry plane")
+    "obs_scrape": "first CMD_OBS scrape served this tracker lifetime "
+                  "(per-scrape counts live in serve_stats.obs_scrapes)",
+    "metrics_delta_folded": "first streamed metric delta folded for a "
+                            "rank: rank (per-delta counts live in the "
+                            "rollup's n_folds)",
+    "obs_evicted": "flight-dump retention removed oldest dumps: n, "
+                   "max_files (rabit_obs_max_files)",
     # elastic worlds (rabit_tpu/elastic, doc/elasticity.md)
     "spare_parked": "hot spare checked in and parked: task_id, blob_version",
     "spare_dropped": "parked spare hung up; removed from the pool",

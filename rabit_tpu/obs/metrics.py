@@ -142,6 +142,21 @@ class Histogram:
             "p99": round(self.percentile(99), 9),
         }
 
+    def raw(self) -> dict:
+        """Mergeable full state (raw bucket counts, not percentile
+        summaries) — the substrate the live-telemetry delta stream
+        subtracts and re-adds (rabit_tpu/obs/stream.py).  ``bounds`` ride
+        along so a receiver can merge histograms it never constructed."""
+        with self._lock:
+            return {
+                "bounds": list(self._bounds),
+                "counts": list(self._counts),
+                "count": self.count,
+                "sum": self.total,
+                "min": self.vmin if self.count else None,
+                "max": self.vmax if self.count else None,
+            }
+
 
 class _Span:
     """Mutable handle yielded by ``MetricsRegistry.timed`` so callers whose
@@ -245,6 +260,16 @@ class MetricsRegistry:
                          f"p99 {1e3 * hist.percentile(99):.3f} ms")
             lines.append(line)
         return "\n".join(lines) if lines else "(no collectives recorded)"
+
+    def raw_state(self) -> dict:
+        """Mergeable counter/histogram state for the live-telemetry delta
+        stream (rabit_tpu/obs/stream.py): raw bucket counts instead of the
+        percentile summaries :meth:`snapshot` emits, so two states can be
+        subtracted into a bounded delta and deltas re-summed losslessly."""
+        with self._lock:
+            counters = {k: c.value for k, c in self._counters.items()}
+            hists = {k: h.raw() for k, h in self._histograms.items()}
+        return {"counters": counters, "histograms": hists}
 
     def snapshot(self) -> dict:
         """JSON-able full state — what workers ship to the tracker."""

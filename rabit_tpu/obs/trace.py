@@ -42,6 +42,7 @@ import math
 import os
 import re
 import threading
+import time
 from dataclasses import dataclass, field
 
 from rabit_tpu.obs.events import Event, load_dump
@@ -175,7 +176,8 @@ def telemetry_name(job_key: str = "") -> str:
     return f"telemetry-{job_key}.json" if job_key else "telemetry.json"
 
 
-def load_job(obs_dir: str, job_key: str = "") -> JobTrace:
+def load_job(obs_dir: str, job_key: str = "",
+             tolerant: bool = False) -> JobTrace:
     """Join every flight dump + telemetry.json under ``obs_dir``.
     ``job_key`` selects one job's telemetry file of a shared multi-job
     obs dir (:func:`telemetry_name`).
@@ -184,13 +186,19 @@ def load_job(obs_dir: str, job_key: str = "") -> JobTrace:
     are merged: events are pooled, exact duplicates (same ts/kind/fields —
     the overlap between a hang dump and the later exit dump of the same
     ring) removed, and the stream re-sorted by ts.  Raises
-    :class:`TraceError` on malformed inputs; an empty dir is fine."""
+    :class:`TraceError` on malformed inputs; an empty dir is fine.
+
+    ``tolerant=True`` skips unreadable inputs instead of raising — the
+    follow-mode contract, where a spill dump may be mid-write or freshly
+    evicted (rabit_obs_max_files) when the exporter lists the dir."""
     job = JobTrace()
     pools: dict[int, dict[str, Event]] = {}
     for path in discover_dumps(obs_dir):
         try:
             events = load_dump(path)
         except (OSError, ValueError, KeyError) as exc:
+            if tolerant:
+                continue
             raise TraceError(f"unreadable flight dump {path}: {exc!r}") from exc
         rank = None
         if events and events[0].kind == "flight_dump":
@@ -199,6 +207,8 @@ def load_job(obs_dir: str, job_key: str = "") -> JobTrace:
         if rank is None:
             ident = parse_dump_name(path)
             if ident is None:
+                if tolerant:
+                    continue
                 raise TraceError(f"flight dump {path} has neither a header "
                                  f"rank nor a parseable filename")
             rank = ident["rank"]
@@ -218,6 +228,8 @@ def load_job(obs_dir: str, job_key: str = "") -> JobTrace:
             with open(tele_path) as f:
                 job.telemetry = json.load(f)
         except (OSError, ValueError) as exc:
+            if tolerant:
+                return job
             raise TraceError(f"unreadable {os.path.basename(tele_path)}: "
                              f"{exc!r}") from exc
         clocks = dict(job.telemetry.get("clocks") or {})
@@ -316,7 +328,7 @@ _RANK_INSTANTS = {
     "engine_error", "checkpoint_commit", "load_checkpoint",
     "checkpoint_loaded", "version_bump", "init_after_exception",
     "engine_finalize", "engine_shutdown", "engine_ready",
-    "epoch_changed", "shard_rebalanced",
+    "epoch_changed", "shard_rebalanced", "obs_evicted",
 }
 
 #: Tracker-side event kinds rendered as instants on the tracker track —
@@ -337,6 +349,7 @@ _TRACKER_INSTANTS = {
     "tracker_failover",
     "job_admitted", "admission_refused", "worker_leased",
     "job_completed",
+    "obs_scrape", "metrics_delta_folded",
 }
 
 
@@ -676,3 +689,54 @@ def export_job(obs_dir: str, out_path: str | None = None,
     if fold:
         fold_into_telemetry(obs_dir, report, job_key=job_key)
     return doc, out_path, report
+
+
+def export_follow(obs_dir: str, out_path: str | None = None,
+                  interval: float = 1.0, fold: bool = True, top_k: int = 3,
+                  job_key: str = "", max_rounds: int | None = None,
+                  should_stop=None,
+                  on_round=None) -> tuple[dict, str, dict, int]:
+    """Tail mode: re-export the trace every ``interval`` seconds while the
+    job is still running (``trace_tool export --follow``).
+
+    Each round merges whatever spill dumps exist so far
+    (``rabit_obs_spill_sec`` makes the flight rings land on disk mid-run)
+    and atomically rewrites ``out_path`` — so at EVERY instant the output
+    is a complete, validated Perfetto document that simply grows between
+    rounds; a reader never sees a torn file.  Dumps that are mid-write or
+    just evicted are skipped (``tolerant`` load), not fatal.
+
+    Stops when the job's telemetry file appears (the tracker writes it at
+    shutdown) — then runs one final *strict* :func:`export_job` so the
+    finished artifact gets the full validation + straggler fold — or after
+    ``max_rounds`` rounds (final pass stays tolerant and unfolded, the job
+    is still live).  ``should_stop()`` and ``on_round(round, doc)`` are
+    test/driver hooks.  Returns ``(doc, out_path, report, rounds)``."""
+    out_path = out_path or os.path.join(
+        obs_dir, f"trace-{job_key}.json" if job_key else "trace.json")
+    tele_path = os.path.join(obs_dir, telemetry_name(job_key))
+    rounds = 0
+    while True:
+        finished = os.path.exists(tele_path)
+        if finished:
+            doc, out_path, report = export_job(
+                obs_dir, out_path, fold=fold, top_k=top_k, job_key=job_key)
+            return doc, out_path, report, rounds + 1
+        job = load_job(obs_dir, job_key=job_key, tolerant=True)
+        doc = build_chrome_trace(job)
+        errs = validate_chrome_trace(doc)
+        if errs:
+            raise TraceError("follow export produced an invalid trace: "
+                             + "; ".join(errs[:5]))
+        tmp = f"{out_path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(doc, f, sort_keys=True)
+        os.replace(tmp, out_path)
+        rounds += 1
+        if on_round is not None:
+            on_round(rounds, doc)
+        if max_rounds is not None and rounds >= max_rounds:
+            return doc, out_path, straggler_report(job, top_k=top_k), rounds
+        if should_stop is not None and should_stop():
+            return doc, out_path, straggler_report(job, top_k=top_k), rounds
+        time.sleep(max(interval, 0.05))

@@ -77,6 +77,7 @@ import socket
 import threading
 import time
 
+from rabit_tpu.obs import stream as obs_stream
 from rabit_tpu.tracker import protocol as P
 
 #: Upstream heartbeat padding: a child's lease is re-advertised to the
@@ -154,6 +155,12 @@ class Relay:
         # coalesced upstream state (drained per flush; all under _lock)
         self._leases: dict[str, _LocalLease] = {}
         self._metrics: dict[str, tuple[int, bytes, float]] = {}
+        # Streamed-metric deltas coalesced per JOB per flush
+        # (doc/observability.md "Live telemetry plane"): unlike the
+        # latest-wins snapshot table above, delta windows ACCUMULATE
+        # (counters sum, histogram buckets add) — replacement would lose
+        # every window but the last.
+        self._deltas: dict[str, dict] = {}
         self._queued: list[P.BatchMsg] = []
         self._held: dict[str, socket.socket] = {}   # parked check-ins
         self._held_msg: dict[str, P.BatchMsg] = {}  # their hellos (for
@@ -190,7 +197,10 @@ class Relay:
         # replays them on the next connect so no check-in, shutdown,
         # print, or quorum report is lost across the cut (doc/ha.md).
         # Heartbeats/metrics are excluded — they re-coalesce every
-        # flush anyway.
+        # flush anyway.  Delta frames (CMD_OBS) are excluded too, the
+        # other way around: a replay after the root DID fold them would
+        # double-count the window, and approximate-but-never-inflated is
+        # the accounting contract across a failover cut.
         self._unacked: list[P.BatchMsg] = []
         self._replay = False
         # evidence counters
@@ -458,9 +468,32 @@ class Relay:
                         now + P.LEASE_FACTOR * interval, h.prev_rank)
             ch.out += P.put_u32(P.ACK) + self._stamp()
         elif h.cmd == P.CMD_METRICS:
+            # Strip any piggybacked streamed-metrics delta BEFORE the
+            # latest-wins snapshot store: the delta folds into the
+            # per-job sum accumulator (no window lost to coalescing, no
+            # double-fold at the tracker), the cumulative snapshot
+            # coalesces as before.  Pure dict math — the child reactor
+            # must never block (doc/static_analysis.md).
+            payload = h.message
+            delta_doc = None
+            try:
+                snap = json.loads(payload)
+                delta = (snap.pop("delta", None)
+                         if isinstance(snap, dict) else None)
+                if isinstance(delta, dict) and delta:
+                    job, _rest = P.split_job(h.task_id)
+                    rank = int(snap.get("rank", h.prev_rank))
+                    delta_doc = obs_stream.delta_doc(job, rank, delta)
+                    payload = json.dumps(snap)
+            except (ValueError, TypeError):
+                delta_doc = None
             with self._lock:
                 self._metrics[h.task_id] = (h.prev_rank,
-                                            h.message.encode(), time.time())
+                                            payload.encode(), time.time())
+                if delta_doc is not None:
+                    job = delta_doc["job"]
+                    self._deltas[job] = obs_stream.merge_delta_doc(
+                        self._deltas.get(job), delta_doc)
             ch.out += P.put_u32(P.ACK) + self._stamp()
         elif h.cmd == P.CMD_EPOCH:
             # Per-job cache first (multi-job service, doc/service.md);
@@ -718,6 +751,19 @@ class Relay:
                 msgs.append(P.BatchMsg(task_id, P.CMD_METRICS, rank, "", 0,
                                        payload, ts))
             self._metrics = {}
+            # streamed-metric deltas: ONE coalesced frame per job per
+            # flush, routed as "<job>/#delta" so a multi-job service
+            # folds each frame into the owning partition.  An oversized
+            # window (> protocol.DELTA_MAX_BYTES compressed) is dropped
+            # whole — bounded frames are the contract.
+            deltas, self._deltas = self._deltas, {}
+            for job, doc in sorted(deltas.items()):
+                try:
+                    frame = P.put_delta_frame(doc)
+                except ValueError:
+                    continue
+                msgs.append(P.BatchMsg(P.join_job(job, "#delta"),
+                                       P.CMD_OBS, -1, "", 0, frame, now))
         return msgs
 
     def _upstream_pump(self) -> None:
@@ -761,13 +807,15 @@ class Relay:
                 with self._lock:
                     self._unacked = [
                         m for m in msgs
-                        if m.cmd not in (P.CMD_HEARTBEAT, P.CMD_METRICS)]
+                        if m.cmd not in (P.CMD_HEARTBEAT, P.CMD_METRICS,
+                                         P.CMD_OBS)]
                 self._drop_channel()
                 continue
             with self._lock:
                 self._unacked = [
                     m for m in msgs
-                    if m.cmd not in (P.CMD_HEARTBEAT, P.CMD_METRICS)]
+                    if m.cmd not in (P.CMD_HEARTBEAT, P.CMD_METRICS,
+                                     P.CMD_OBS)]
             self.stats["batches"] += 1
             self.stats["batch_msgs"] += len(msgs)
             self._ack.wait(self.rpc_timeout)

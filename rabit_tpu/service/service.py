@@ -57,6 +57,7 @@ import time
 import threading
 
 from rabit_tpu.config import Config
+from rabit_tpu.obs import stream as obs_stream
 from rabit_tpu.service.registry import JobRegistry, tenant_of
 from rabit_tpu.service.state import ServiceState
 from rabit_tpu.tracker import protocol as P
@@ -342,6 +343,18 @@ class CollectiveService(Tracker):
             # prefixed one (the caller keeps the full route key).
             route_id = route_id[2:]
         job, rest = P.split_job(route_id)
+        if cmd == P.CMD_OBS:
+            # Live-telemetry routing (doc/observability.md "Live
+            # telemetry plane"): a job-prefixed id reaches that job's
+            # partition (its scrape, or a relay-coalesced "<job>/#delta"
+            # frame); everything else gets the SERVICE-level view —
+            # never admission (a scrape must not mint a job).
+            if job:
+                part = self.partition(job)
+                return (part if part is not None else self), \
+                    (rest if part is not None else task_id)
+            part = self.partition("") if rest == "#delta" else None
+            return (part if part is not None else self), task_id
         if job == P.POOL_PREFIX:
             # A pooled worker: CMD_SPARE (re-)parks it in the SERVICE
             # pool (releasing any stale lease); every other command
@@ -491,6 +504,41 @@ class CollectiveService(Tracker):
         info["jobs"] = {key: part._epoch_info()
                         for key, part in self._parts_items()}
         return info
+
+    # -- live telemetry plane (doc/observability.md) -------------------------
+
+    def build_scrape(self, opts: dict | None = None) -> dict:
+        """The multi-tenant CMD_OBS exposition: the service's own live
+        section plus a ``tenants`` map shaped tenant -> job -> rank ->
+        link — the accounting schema the QoS scheduler and pool
+        autoscaler consume.  Each tenant section precomputes its
+        ``wire_bytes`` split by (job, codec, fused) from the jobs'
+        streamed rollups, so a policy loop needs no client-side math."""
+        doc = super().build_scrape(opts)
+        with self._lock:
+            pool = sum(1 for s in self._spares
+                       if s.task_id.startswith(_POOL_ROUTE))
+        doc["service"] = {
+            **self.registry.stats(),
+            "live": self.live_jobs(),
+            "pool_parked": pool,
+            "auto_world": self.auto_world,
+        }
+        tenants: dict[str, dict] = {}
+        for key, part in self._parts_items():
+            jdoc = part._scrape_job_state()
+            tenant = tenants.setdefault(
+                tenant_of(key),
+                {"jobs": {}, "wire_bytes": {}, "wire_bytes_total": 0})
+            tenant["jobs"][key] = jdoc
+            by_codec = obs_stream.wire_bytes_by_codec(
+                jdoc["stream"]["total"])
+            for codec, n in by_codec.items():
+                tenant["wire_bytes"][codec] = (
+                    tenant["wire_bytes"].get(codec, 0) + n)
+                tenant["wire_bytes_total"] += n
+        doc["tenants"] = tenants
+        return doc
 
     # -- lifecycle -----------------------------------------------------------
 

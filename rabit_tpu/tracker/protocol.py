@@ -191,6 +191,7 @@ MAGIC_ASSIGN = 0x7AB17002
 MAGIC_LINK = 0x7AB17003
 MAGIC_BLOB = 0x7AB17004
 MAGIC_SKIP = 0x7AB17005
+MAGIC_DELTA = 0x7AB17006
 ACK = 0
 
 CMD_START = 1
@@ -214,6 +215,15 @@ CMD_HANGUP = 12
 #: The reply is ACK followed by a stream of journal frames (a snapshot
 #: record first, then every mutation as it commits).
 CMD_JOURNAL = 13
+#: Live-telemetry introspection (rabit_tpu/obs/stream.py,
+#: doc/observability.md "Live telemetry plane").  As a worker hello the
+#: message field selects the scrape view (a JSON options doc, usually
+#: ``{}``); the reply is ACK + one JSON exposition of the tracker's live
+#: state (jobs, epochs, leases, spare pool, quorum depth, admission
+#: counters, folded metric rollups).  As a relay batch sub-message the
+#: payload is one coalesced per-job metric-delta frame
+#: (:func:`put_delta_frame`) the tracker folds into its rollups.
+CMD_OBS = 14
 
 #: put_route_frame flags bit 0: close the child connection after
 #: delivering this frame's payload (the tracker's "conn.close()" crossing
@@ -418,7 +428,7 @@ def send_hello(
     if cmd in (CMD_START, CMD_RECOVER, CMD_SPARE):
         out.append(put_u32(listen_port))
     elif cmd in (CMD_PRINT, CMD_METRICS, CMD_HEARTBEAT, CMD_EPOCH,
-                 CMD_QUORUM):
+                 CMD_QUORUM, CMD_OBS):
         out.append(put_str(message))
     elif cmd == CMD_BLOB:
         out += [put_u32(blob_version), put_u32(len(blob)), blob]
@@ -674,6 +684,70 @@ def read_route_frame(sock) -> tuple[str, int, bytes]:
     return task_id, flags, recv_exact(sock, n) if n else b""
 
 
+#: Hard cap on one encoded metric-delta frame.  Deltas are BOUNDED by
+#: design (a few counters + fixed-bucket histograms per rank); anything
+#: larger is a torn frame or a foreign writer, not a bigger delta.
+DELTA_MAX_BYTES = 4 << 20
+
+
+def put_delta_frame(doc: dict) -> bytes:
+    """Encode one coalesced metric-delta document (rabit_tpu/obs/stream.py
+    schema) as a self-delimiting frame: MAGIC_DELTA + encoded length +
+    zlib-compressed canonical JSON.  The same bytes ride as a CMD_OBS
+    BatchMsg payload (relay -> tracker) and over a direct socket."""
+    import json as _json
+    import zlib as _zlib
+
+    payload = _zlib.compress(_json.dumps(
+        doc, sort_keys=True, separators=(",", ":")).encode())
+    if len(payload) > DELTA_MAX_BYTES:
+        raise ValueError(f"oversized delta frame ({len(payload)} bytes)")
+    return put_u32(MAGIC_DELTA) + put_u32(len(payload)) + payload
+
+
+def read_delta_frame(sock) -> dict:
+    """Read one delta frame off a blocking stream; raises ValueError on a
+    bad magic / oversized length / undecodable payload (a torn frame) and
+    ConnectionError on EOF."""
+    magic = get_u32(sock)
+    if magic != MAGIC_DELTA:
+        raise ValueError(f"bad delta magic {magic:#x}")
+    n = get_u32(sock)
+    if n > DELTA_MAX_BYTES:
+        raise ValueError(f"oversized delta frame ({n} bytes)")
+    return _decode_delta_payload(recv_exact(sock, n) if n else b"")
+
+
+def delta_frame_from_bytes(data: bytes) -> dict:
+    """Parse one COMPLETE delta frame held in memory (a CMD_OBS BatchMsg
+    payload).  Raises ValueError on bad magic, a length that disagrees
+    with the buffer (torn frame), or an undecodable payload."""
+    if len(data) < 8:
+        raise ValueError(f"short delta frame ({len(data)} bytes)")
+    magic = _U32.unpack_from(data, 0)[0]
+    if magic != MAGIC_DELTA:
+        raise ValueError(f"bad delta magic {magic:#x}")
+    n = _U32.unpack_from(data, 4)[0]
+    if n > DELTA_MAX_BYTES:
+        raise ValueError(f"oversized delta frame ({n} bytes)")
+    if len(data) != 8 + n:
+        raise ValueError(f"torn delta frame ({len(data)} of {8 + n} bytes)")
+    return _decode_delta_payload(data[8:])
+
+
+def _decode_delta_payload(payload: bytes) -> dict:
+    import json as _json
+    import zlib as _zlib
+
+    try:
+        doc = _json.loads(_zlib.decompress(payload).decode())
+    except (ValueError, _zlib.error, UnicodeDecodeError) as exc:
+        raise ValueError(f"delta frame undecodable: {exc!r}")
+    if not isinstance(doc, dict):
+        raise ValueError("delta frame payload is not an object")
+    return doc
+
+
 @dataclass
 class Hello:
     """One parsed worker hello (the event-loop serving path's unit of
@@ -709,7 +783,7 @@ def hello_parser():
         listen_port = _U32.unpack((yield 4))[0]
         return Hello(cmd, prev_rank, task_id, listen_port=listen_port)
     if cmd in (CMD_PRINT, CMD_METRICS, CMD_HEARTBEAT, CMD_EPOCH,
-               CMD_QUORUM):
+               CMD_QUORUM, CMD_OBS):
         n = _U32.unpack((yield 4))[0]
         if n > 64 << 20:
             raise ValueError(f"oversized message ({n} bytes)")
@@ -882,7 +956,7 @@ def tracker_rpc(
                     # plus the local send/recv bracket is one clock sample
                     server_ts = float(get_str(sock))
                     return TimedAck(ack, server_ts, t_send, time.time())
-                if cmd in (CMD_EPOCH, CMD_QUORUM):
+                if cmd in (CMD_EPOCH, CMD_QUORUM, CMD_OBS):
                     import json as _json
 
                     return _json.loads(get_str(sock))

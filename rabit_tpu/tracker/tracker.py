@@ -106,7 +106,9 @@ from typing import Callable
 from rabit_tpu import sched
 from rabit_tpu.config import Config
 from rabit_tpu.elastic.membership import CLOSE, MembershipManager
+from rabit_tpu.obs import stream as obs_stream
 from rabit_tpu.obs.events import event_from_stats_line
+from rabit_tpu.obs.metrics import GLOBAL_REGISTRY
 from rabit_tpu.quorum import QuorumTable
 from rabit_tpu.tracker import protocol as P
 
@@ -401,6 +403,13 @@ class Tracker:
         self.obs_dir = obs_dir
         self.events: list[dict] = []
         self.snapshots: dict[int, dict] = {}  # rank -> latest shipped snapshot
+        # Live telemetry plane (doc/observability.md): streamed metric
+        # deltas (piggybacked on CMD_METRICS snapshots, or relay-coalesced
+        # CMD_OBS batch frames) fold into per-rank/per-job rollups that a
+        # CMD_OBS scrape renders live, without touching a worker.
+        self._stream = obs_stream.StreamRollup()
+        self._delta_ranks: set[str] = set()  # first-fold evidence, per rank
+        self._obs_scraped = False  # first-scrape evidence (one event)
         self.telemetry: dict | None = None
         self._started_at = time.time()
         self._n_starts: dict[str, int] = {}  # task_id -> CMD_START check-ins
@@ -497,6 +506,7 @@ class Tracker:
         self.serve_stats: dict[str, int] = {
             "accepts": 0, "rpcs": 0, "handler_threads_hwm": 0,
             "reactor_conns_hwm": 0, "batches": 0, "batch_msgs": 0,
+            "obs_scrapes": 0,
         }
         self._stats_lock = threading.Lock()
         self._handler_threads = 0
@@ -854,6 +864,30 @@ class Tracker:
                 # ACK observes the drop too.
                 self._drop_lease_locked(h.task_id)
             return P.put_u32(P.ACK), lambda: self._note_shutdown(h.task_id)
+        if h.cmd == P.CMD_OBS:
+            # Live-telemetry scrape (doc/observability.md "Live telemetry
+            # plane"): the exposition is assembled from already-locked
+            # copies of live state — no file IO, no wave waits — so it
+            # serves inline on the reactor (the reactor-blocking
+            # invariant, doc/static_analysis.md).
+            try:
+                opts = json.loads(h.message) if h.message else {}
+            except ValueError:
+                opts = {}
+            doc = self.build_scrape(opts if isinstance(opts, dict) else {})
+            with self._stats_lock:
+                self.serve_stats["obs_scrapes"] += 1
+            with self._lock:
+                if not self._obs_scraped:
+                    # One event per tracker lifetime — evidence the live
+                    # plane was used, without a 1 Hz scraper flooding the
+                    # event timeline for hours.
+                    self._obs_scraped = True
+                    self.events.append({
+                        "ts": round(time.time(), 6), "kind": "obs_scrape",
+                        "task_id": h.task_id,
+                    })
+            return P.put_u32(P.ACK) + P.put_str(json.dumps(doc)), None
         raise ValueError(f"unknown tracker cmd {h.cmd}")
 
     def _epoch_info(self) -> dict:
@@ -1317,6 +1351,12 @@ class Tracker:
                 channel.send_route(
                     m.task_id, P.ROUTE_CLOSE,
                     P.put_u32(P.ACK) + P.put_str(json.dumps(reply)))
+            elif m.cmd == P.CMD_OBS:
+                # A relay-coalesced streamed-metrics delta frame
+                # (doc/observability.md "Live telemetry plane"): fold
+                # into the live rollup, no reply (fire-and-forget, like
+                # the heartbeat/metrics it piggybacks on).
+                tr._fold_delta_frame(m.payload, ts)
             elif m.cmd == P.CMD_HANGUP:
                 # The relay saw a parked child's connection EOF: make its
                 # virtual connection read as hung up so the wave purge
@@ -1920,6 +1960,87 @@ class Tracker:
         with self._lock:
             return sorted(self._leases)
 
+    # -- live telemetry plane (doc/observability.md) -----------------------
+
+    def _fold_delta_frame(self, payload: bytes,
+                          ts: float | None = None) -> None:
+        """Fold one relay-coalesced CMD_OBS metric-delta frame.  Pure
+        dict math over an already-received payload — safe inside the
+        relay batch fold (reactor-blocking family)."""
+        self._fold_delta_doc(P.delta_frame_from_bytes(payload), ts)
+
+    def _fold_delta_doc(self, doc: dict, ts: float | None = None) -> None:
+        """Fold one delta document ({schema, job, ranks: {rank: delta}})
+        into the live rollup.  Unknown schema versions are dropped whole —
+        a newer worker must not half-corrupt an older tracker's rollup."""
+        if doc.get("schema") != obs_stream.STREAM_SCHEMA:
+            return
+        stamp = ts if ts is not None else round(time.time(), 6)
+        for rank, delta in doc.get("ranks", {}).items():
+            if not isinstance(delta, dict):
+                continue
+            self._stream.fold(rank, delta, ts=stamp)
+            with self._lock:
+                if str(rank) not in self._delta_ranks:
+                    # First-fold evidence per rank (not per delta — a
+                    # heartbeat-cadence stream would flood the timeline).
+                    self._delta_ranks.add(str(rank))
+                    self.events.append({
+                        "ts": stamp, "kind": "metrics_delta_folded",
+                        "rank": str(rank),
+                    })
+
+    def _scrape_job_state(self) -> dict:
+        """One job's live scrape section, assembled from already-locked
+        copies of control state (never file IO): membership, leases, the
+        spare pool, admission/wave pressure, quorum ledger depth, and the
+        streamed-metrics rollup.  The schema (job -> rank -> link) is the
+        contract the QoS/autoscaler/route-around loops consume."""
+        with self._lock:
+            live = {
+                "epoch": self.elastic.epoch,
+                "world": self.world_size,
+                "base_world": self.base_world,
+                "leases": len(self._leases),
+                "spares": len(self._spares),
+                "pending": len(self._pending),
+                "n_shutdown": self._n_shutdown,
+                "restarts": sum(n - 1 for n in self._n_starts.values()
+                                if n > 1),
+                "quorum_outstanding": (len(self._quorum.outstanding())
+                                       if self._quorum is not None else 0),
+                "link_flags": len(self._link_flags),
+                "n_events": len(self.events),
+                "n_snapshots": len(self.snapshots),
+                "messages_dropped": self.messages_dropped,
+            }
+        # The rollup carries its own leaf lock; render it OUTSIDE
+        # self._lock (lock-order discipline, doc/static_analysis.md).
+        live["stream"] = self._stream.render()
+        return live
+
+    def build_scrape(self, opts: dict | None = None) -> dict:
+        """The CMD_OBS exposition: a versioned JSON document of live
+        tracker state + per-job rollups + this process's own metrics
+        registry.  ``opts`` (the RPC payload) may set ``registry: false``
+        to skip the registry section (cheaper high-frequency polls).
+        A CollectiveService overrides this with the multi-tenant view
+        (tenant -> job -> rank -> link, doc/service.md)."""
+        opts = opts or {}
+        with self._stats_lock:
+            serve = dict(self.serve_stats)
+        doc = {
+            "schema": obs_stream.STREAM_SCHEMA,
+            "ts": round(time.time(), 6),
+            "started_at": round(self._started_at, 6),
+            "serving": {"reactor": self._reactor, "backlog": self.backlog,
+                        **serve},
+            "jobs": {self.job or "": self._scrape_job_state()},
+        }
+        if opts.get("registry", True):
+            doc["registry"] = GLOBAL_REGISTRY.snapshot()
+        return doc
+
     # -- telemetry ---------------------------------------------------------
 
     def _accept_snapshot(self, payload: str) -> None:
@@ -1932,8 +2053,13 @@ class Tracker:
         try:
             snap = json.loads(payload)
             rank = int(snap.get("rank", -1))
-        except (ValueError, TypeError):
+        except (ValueError, TypeError, AttributeError):
             return  # malformed snapshot must not hurt the tracker
+        # The piggybacked streamed-metrics delta (doc/observability.md
+        # "Live telemetry plane") is stripped BEFORE the snapshot is
+        # stored: the stored snapshot stays cumulative-only, and a
+        # latest-per-rank replacement can never lose a window.
+        delta = snap.pop("delta", None)
         # Validate against the LARGEST world this job has seen: a shrunken
         # world must not reject the final snapshot of a rank that was valid
         # in the epoch the snapshot describes.
@@ -1950,6 +2076,10 @@ class Tracker:
                 "ts": round(time.time(), 6), "kind": "metrics_snapshot",
                 "rank": rank, "task_id": snap.get("task_id", ""),
             })
+        if isinstance(delta, dict) and delta:
+            self._fold_delta_doc({"schema": obs_stream.STREAM_SCHEMA,
+                                  "job": self.job,
+                                  "ranks": {str(rank): delta}})
 
     def build_telemetry(self) -> dict:
         """Assemble the job-level telemetry document: per-rank op latency
@@ -1963,6 +2093,10 @@ class Tracker:
                              if self._quorum is not None else [])
         with self._stats_lock:
             serve = dict(self.serve_stats)
+        # The live-plane rollup rides into the post-mortem document too:
+        # a scrape taken mid-run and the shutdown telemetry.json agree
+        # byte-for-byte on every fully-folded cumulative counter.
+        stream_rollup = self._stream.render()
         waves = [e for e in events if e["kind"] == "wave"]
         # Per-rank clock-offset estimates (tracker_ts = worker_ts +
         # offset_s), shipped inside snapshots; the trace merger uses these
@@ -2012,6 +2146,7 @@ class Tracker:
                        for we in self.elastic.history],
             "restarts": restarts,
             "clocks": clocks,
+            "stream": stream_rollup,
             "waves": waves,
             "events": events,
             "ranks": snapshots,

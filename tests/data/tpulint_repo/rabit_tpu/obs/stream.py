@@ -1,0 +1,20 @@
+"""tpulint fixture: the streamed-metric registry (STREAM_METRICS).
+
+Mirrors rabit_tpu/obs/stream.py just enough for the streammetrics
+family: one declared-and-streamed name, one declared-but-never-streamed
+name (the ``stream-metric-unstreamed`` seed anchors to its declaration
+line), producers live in ../../store.py.
+"""
+
+STREAM_METRICS = {
+    "wire_bytes": "post-codec bytes on the wire",
+    "ghost_metric": "declared but nothing streams it",  # SEEDED: stream-metric-unstreamed
+}
+
+
+def stream_count(name, n, **labels):
+    pass
+
+
+def stream_observe(name, value, **labels):
+    pass

@@ -1,7 +1,15 @@
-"""tpulint fixture: a read of an undeclared config key."""
+"""tpulint fixture: a read of an undeclared config key, plus a streamed
+metric whose name is not declared in STREAM_METRICS."""
+
+from rabit_tpu.obs.stream import stream_count
 
 
 def resolve(cfg):
     good = cfg.get("rabit_fixture_knob", "1")
     bad = cfg.get("rabit_not_a_knob", "")  # SEEDED: config-key-unknown
     return good, bad
+
+
+def meter(nbytes):
+    stream_count("wire_bytes", nbytes, codec="i8")
+    stream_count("wire_byts", nbytes)  # SEEDED: stream-metric-unregistered

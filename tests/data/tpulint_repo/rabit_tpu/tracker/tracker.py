@@ -64,6 +64,15 @@ class Reactor:
     def _freeze(self):
         self._journal("rogue_record", x=1)  # SEEDED: journal-kind-unapplied
 
+    # -- CMD_OBS scrape path (must stay pure computation) -------------------
+
+    def _fold_batch_msg(self, m):
+        if m.cmd == 14:  # CMD_OBS
+            self._handle_obs(m)
+
+    def _handle_obs(self, m):
+        time.sleep(0.01)  # SEEDED-OBS: reactor-blocking
+
     # -- lock order --------------------------------------------------------
 
     def _grab_fwd(self):

@@ -55,6 +55,9 @@ def seeded_line(relpath: str, rule: str) -> int:
     ("config-key-unknown", "rabit_tpu/store.py"),
     ("config-key-undefaulted", "doc/parameters.md"),
     ("config-key-undocumented", "rabit_tpu/config.py"),
+    # family 3b: streamed-metric registry (live telemetry plane)
+    ("stream-metric-unregistered", "rabit_tpu/store.py"),
+    ("stream-metric-unstreamed", "rabit_tpu/obs/stream.py"),
     # family 4: wire-protocol symmetry
     ("wire-cmd-mismatch", "rabit_tpu/tracker/protocol.py"),
     ("wire-cmd-unhandled", "rabit_tpu/tracker/protocol.py"),
@@ -89,6 +92,21 @@ def test_fixture_violation_flagged(rule, relpath):
             rf"^{re.escape(relpath)}:{line}: \[{re.escape(rule)}\]")
     assert any(pat.match(l) for l in proc.stdout.splitlines()), (
         f"expected {rule} at {relpath}: got\n{proc.stdout}")
+
+
+def test_fixture_obs_handler_blocking_flagged():
+    """A blocking call on the CMD_OBS scrape path (reached from the
+    _fold_batch_msg reactor entry) is flagged too.  Distinct marker:
+    ``seeded_line()`` returns only the FIRST reactor-blocking seed."""
+    proc = run_tpulint("--root", str(FIXTURE))
+    relpath = "rabit_tpu/tracker/tracker.py"
+    line = next(
+        i for i, l in enumerate(
+            (FIXTURE / relpath).read_text().splitlines(), 1)
+        if "SEEDED-OBS: reactor-blocking" in l)
+    pat = re.compile(
+        rf"^{re.escape(relpath)}:{line}: \[reactor-blocking\]")
+    assert any(pat.match(l) for l in proc.stdout.splitlines()), proc.stdout
 
 
 def test_fixture_native_only_constant_flagged():

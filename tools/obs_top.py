@@ -1,0 +1,24 @@
+"""Repo-root launcher for rabit-top (``rabit_tpu/obs/top.py``).
+
+Same CLI as ``python -m rabit_tpu.obs.top`` — a poll-based, curses-free
+live view of a running tracker/service over the CMD_OBS scrape RPC:
+
+  python tools/obs_top.py HOST:PORT [--interval 2] [--job KEY]
+                          [--once] [--json] [--registry]
+
+See doc/observability.md, "Live telemetry plane".
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+from rabit_tpu.obs.top import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -19,7 +19,13 @@ the recovery_bench/chaos harness shape):
   stay within ``--bar`` (default 1.2x) of its own clean-arm run;
 * **pooled** — ``--pool P`` warm pooled workers serving ``--pool-jobs``
   successive pool-filled fits (doc/service.md "Pooled workers"),
-  measuring fits/sec on a warm pool and the leases-per-worker reuse.
+  measuring fits/sec on a warm pool and the leases-per-worker reuse;
+* **observed** — ``--observed`` re-runs the clean scenario with the live
+  telemetry plane attached (doc/observability.md): a ``--scrape-hz``
+  CMD_OBS scraper polling the service plus a follow-mode trace exporter
+  tailing the periodic flight spills, asserting job wall-clocks and boot
+  p99 stay within ``--obs-bar`` (default 1.05x) of the unobserved clean
+  arm — observation must be provably cheap.
 
 Every record is one JSON line with ``"bench": "service"`` (the bench.py
 driver embeds them under ``rec["service"]``; RABIT_BENCH_SERVICE=0
@@ -37,6 +43,7 @@ import argparse
 import io
 import json
 import sys
+import tempfile
 import threading
 import time
 from pathlib import Path
@@ -47,7 +54,11 @@ REPO = Path(__file__).resolve().parents[1]
 if str(REPO) not in sys.path:
     sys.path.insert(0, str(REPO))
 
+from rabit_tpu import obs  # noqa: E402
+from rabit_tpu.config import Config  # noqa: E402
 from rabit_tpu.elastic.client import ElasticWorker  # noqa: E402
+from rabit_tpu.obs import trace as obs_trace  # noqa: E402
+from rabit_tpu.obs.top import scrape as obs_scrape  # noqa: E402
 from rabit_tpu.relay import Relay  # noqa: E402
 from rabit_tpu.service import CollectiveService, PooledWorker  # noqa: E402
 from rabit_tpu.tracker import protocol as P  # noqa: E402
@@ -198,10 +209,15 @@ def run_fleet(jobs: list[JobRun], stagger: float) -> float:
 def bench_service(n_jobs: int, world: int, niter: int, sleep: float,
                   relays: int, chaos: str, straggle: float, bar: float,
                   pool: int, pool_jobs: int, deadline: float,
-                  assert_isolation: bool, stagger: float = 0.05) -> list[dict]:
+                  assert_isolation: bool, stagger: float = 0.05,
+                  observed: bool = False, obs_bar: float = 1.05,
+                  scrape_hz: float = 1.0,
+                  obs_dir: str = "") -> list[dict]:
     assert_legacy_wire_identical()
     records: list[dict] = []
-    svc = CollectiveService(quiet=True).start()
+    if observed and not obs_dir:
+        obs_dir = tempfile.mkdtemp(prefix="rabit-obs-bench-")
+    svc = CollectiveService(quiet=True, obs_dir=obs_dir or None).start()
     tier = [Relay((svc.host, svc.port), relay_id=f"r{i}",
                   flush_sec=0.05).start() for i in range(relays)]
 
@@ -304,6 +320,94 @@ def bench_service(n_jobs: int, world: int, niter: int, sleep: float,
         assert fits_ok == pool_jobs and fits_bitwise, \
             "pooled arm: a pool-filled fit failed"
 
+    # -- observed arm: the clean scenario + live telemetry attached --------
+    if observed:
+        # Periodic flight-ring spill in THIS process (the workers are
+        # in-thread), so the follow exporter has live rings to tail
+        # (doc/observability.md "Live telemetry plane").
+        obs.configure(Config([f"rabit_obs_dir={obs_dir}",
+                              "rabit_obs_spill_sec=0.5"]), rank=0)
+        for i in range(n_jobs):
+            svc.admit(f"obs{i}", world)
+        fleet = [JobRun(f"obs{i}", world, niter, sleep, addr_for(i),
+                        deadline) for i in range(n_jobs)]
+        stop = threading.Event()
+        scr = {"n": 0, "errors": 0, "lat": [], "live_max": 0}
+        follow = {"rounds": 0, "events": 0, "error": ""}
+
+        def scraper():
+            while not stop.is_set():
+                t0 = time.monotonic()
+                try:
+                    doc = obs_scrape(svc.host, svc.port)
+                    scr["lat"].append(time.monotonic() - t0)
+                    scr["n"] += 1
+                    scr["live_max"] = max(
+                        scr["live_max"],
+                        len(doc.get("service", {}).get("live", [])))
+                except Exception:  # noqa: BLE001 — observation is best-effort
+                    scr["errors"] += 1
+                stop.wait(1.0 / max(scrape_hz, 0.1))
+
+        def follower():
+            def on_round(n, doc):
+                follow["rounds"] = n
+                follow["events"] = len(doc.get("traceEvents", []))
+
+            try:
+                obs_trace.export_follow(obs_dir, interval=1.0,
+                                        should_stop=stop.is_set,
+                                        on_round=on_round)
+            except Exception as e:  # noqa: BLE001 — recorded, never fatal
+                follow["error"] = f"{type(e).__name__}: {e}"
+
+        watchers = [threading.Thread(target=scraper, daemon=True),
+                    threading.Thread(target=follower, daemon=True)]
+        for t in watchers:
+            t.start()
+        wall = run_fleet(fleet, stagger)
+        stop.set()
+        for t in watchers:
+            t.join(timeout=10)
+        boots = [b for j in fleet for b in j.boot_lat]
+        clean_boots = [b for j in clean for b in j.boot_lat]
+        ratios = [(o.wall / c.wall) for o, c in zip(fleet, clean)
+                  if c.wall > 0]
+        p99_ratio = (pctl(boots, 99) / pctl(clean_boots, 99)
+                     if pctl(clean_boots, 99) > 0 else -1.0)
+        ok = all(j.completed() and j.bitwise_ok() for j in fleet)
+        rec = dict(base, mode="observed", scrape_hz=scrape_hz,
+                   wall_s=round(wall, 3),
+                   jobs_per_sec=round(n_jobs / wall, 3),
+                   boot_p50_ms=round(pctl(boots, 50) * 1e3, 3),
+                   boot_p99_ms=round(pctl(boots, 99) * 1e3, 3),
+                   boot_p99_ratio=round(p99_ratio, 3),
+                   job_wall_ratio_max=round(max(ratios), 3) if ratios
+                   else -1.0,
+                   overhead_bar=obs_bar,
+                   overhead_asserted=assert_isolation,
+                   scrapes=scr["n"], scrape_errors=scr["errors"],
+                   scrape_p99_ms=round(pctl(scr["lat"], 99) * 1e3, 3),
+                   live_jobs_max=scr["live_max"],
+                   follow_rounds=follow["rounds"],
+                   follow_trace_events=follow["events"],
+                   follow_error=follow["error"],
+                   bitwise_ok=ok, completed=ok)
+        records.append(rec)
+        assert ok, "observed arm: a job failed to complete " \
+                   "bitwise-identically under observation"
+        assert scr["n"] > 0 and scr["errors"] == 0, \
+            f"observed arm: scraper failed ({scr['errors']} error(s))"
+        assert not follow["error"], \
+            f"observed arm: follow exporter failed: {follow['error']}"
+        if assert_isolation:
+            assert ratios and max(ratios) <= obs_bar, (
+                f"observed arm: job wall-clock {max(ratios):.3f}x its "
+                f"unobserved run (> {obs_bar}x) — observation is not cheap")
+            assert 0 < p99_ratio <= obs_bar, (
+                f"observed arm: boot p99 {p99_ratio:.3f}x the unobserved "
+                f"arm (> {obs_bar}x) — observation is not cheap")
+
     tele = svc.build_telemetry()
     records.append(dict(base, mode="summary",
                         wire_legacy_identical=True,
@@ -341,6 +445,18 @@ def main(argv: "list[str] | None" = None) -> int:
     ap.add_argument("--pool-jobs", type=int, default=4,
                     help="successive pool-filled fits")
     ap.add_argument("--deadline", type=float, default=90.0)
+    ap.add_argument("--observed", action="store_true",
+                    help="re-run the clean scenario with a live CMD_OBS "
+                         "scraper + follow-mode trace exporter attached "
+                         "and hold the overhead bar")
+    ap.add_argument("--obs-bar", type=float, default=1.05,
+                    help="observed-arm overhead bar (x the unobserved "
+                         "clean arm, walls and boot p99)")
+    ap.add_argument("--scrape-hz", type=float, default=1.0,
+                    help="observed-arm scrape cadence")
+    ap.add_argument("--obs-dir", default="",
+                    help="observability dir for the observed arm "
+                         "(default: a fresh temp dir)")
     ap.add_argument("--smoke", action="store_true",
                     help="CI size: fewer rounds, isolation recorded but "
                          "not asserted (oversubscribed machines)")
@@ -360,7 +476,9 @@ def main(argv: "list[str] | None" = None) -> int:
         sleep=args.sleep, relays=args.relays, chaos=args.chaos,
         straggle=args.straggle, bar=args.bar, pool=args.pool,
         pool_jobs=args.pool_jobs, deadline=args.deadline,
-        assert_isolation=not args.smoke)
+        assert_isolation=not args.smoke, observed=args.observed,
+        obs_bar=args.obs_bar, scrape_hz=args.scrape_hz,
+        obs_dir=args.obs_dir)
     for rec in records:
         print(json.dumps(rec, sort_keys=True), flush=True)
     return 0

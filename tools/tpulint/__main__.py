@@ -33,6 +33,7 @@ from tools.tpulint import (
     ownership,
     reactor,
     registry,
+    streammetrics,
     wire,
 )
 from tools.tpulint.core import (
@@ -97,6 +98,15 @@ def run(root: Path) -> list[Finding]:
         config_py_rel=rel(config_py, root),
         parameters_md_rel="doc/parameters.md",
     )
+
+    # 3b. streamed-metric registry (the live telemetry plane's
+    # stringly-typed producer surface; same closure discipline as the
+    # event-kind registry)
+    stream_py = root / "rabit_tpu" / "obs" / "stream.py"
+    findings += streammetrics.check_stream_metrics(
+        streammetrics.load_stream_metrics(stream_py),
+        streammetrics.collect_stream_calls(emit_files, root),
+        stream_py_rel=rel(stream_py, root))
 
     # 4. wire-protocol symmetry
     protocol_py = root / "rabit_tpu" / "tracker" / "protocol.py"

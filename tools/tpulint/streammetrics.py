@@ -1,0 +1,115 @@
+"""Streamed-metric registry: stream_count/stream_observe ↔ STREAM_METRICS.
+
+The live telemetry plane (rabit_tpu/obs/stream.py; doc/observability.md
+"Live telemetry plane") is stringly typed end to end: producers write
+labeled series under a base name, the relay coalesce / tracker fold /
+obs_top rendering all key off that same string.  A typo'd producer name
+silently starves every consumer — the scrape still renders, the QoS loop
+just never sees the series.  Two invariants, mirroring the event-kind
+registry (tools/tpulint/registry.py):
+
+* ``stream-metric-unregistered`` — a ``stream_count``/``stream_observe``
+  call whose literal metric name is not declared in
+  ``stream.STREAM_METRICS``;
+* ``stream-metric-unstreamed`` — a declared metric no producer ever
+  streams (dead registry entry, anchored at its declaration line).
+
+Non-literal first arguments are out of scope (none exist today — add a
+declared-name assertion at the call site if one ever appears).
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+
+from tools.tpulint.core import Finding, const_str, parse_python, rel
+
+RULE_UNREGISTERED = "stream-metric-unregistered"
+RULE_UNSTREAMED = "stream-metric-unstreamed"
+
+_PRODUCERS = frozenset({"stream_count", "stream_observe"})
+
+
+def load_stream_metrics(stream_py: Path) -> dict[str, int]:
+    """name -> declaration line from the ``STREAM_METRICS = {...}``
+    literal (empty when the module is missing — every producer call then
+    reports as unregistered, the loud failure we want)."""
+    tree = parse_python(stream_py)
+    if tree is None:
+        return {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            names = [t.id for t in node.targets if isinstance(t, ast.Name)]
+        elif isinstance(node, ast.AnnAssign):
+            names = [node.target.id] if isinstance(node.target,
+                                                   ast.Name) else []
+        else:
+            continue
+        if "STREAM_METRICS" not in names or not isinstance(node.value,
+                                                           ast.Dict):
+            continue
+        out: dict[str, int] = {}
+        for key in node.value.keys:
+            s = const_str(key) if key is not None else None
+            if s is not None:
+                out[s] = key.lineno
+        return out
+    return {}
+
+
+def collect_stream_calls(files: list[Path],
+                         root: Path) -> list[tuple[str, int, str]]:
+    """(relpath, line, name) for every literal-named producer call —
+    bare ``stream_count(...)`` and attribute forms
+    (``obs_stream.stream_count``) both count.  The defining module is
+    skipped: its docstring/implementation is the registry itself."""
+    out: list[tuple[str, int, str]] = []
+    for path in files:
+        if path.name == "stream.py" and path.parent.name == "obs":
+            continue
+        tree = parse_python(path)
+        if tree is None:
+            continue
+        rpath = rel(path, root)
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            fn = node.func
+            name = fn.attr if isinstance(fn, ast.Attribute) else \
+                fn.id if isinstance(fn, ast.Name) else None
+            if name not in _PRODUCERS:
+                continue
+            metric = const_str(node.args[0])
+            if metric is not None:
+                out.append((rpath, node.lineno, metric))
+    return out
+
+
+def check_stream_metrics(
+    declared: dict[str, int],
+    calls: list[tuple[str, int, str]],
+    stream_py_rel: str = "rabit_tpu/obs/stream.py",
+) -> list[Finding]:
+    findings: list[Finding] = []
+    seen: set[tuple[str, str]] = set()
+    for rpath, line, metric in calls:
+        if metric in declared or (rpath, metric) in seen:
+            continue
+        seen.add((rpath, metric))
+        findings.append(Finding(
+            RULE_UNREGISTERED, rpath, line,
+            f"streamed metric {metric!r} is not declared in "
+            f"stream.STREAM_METRICS — a typo here silently starves every "
+            f"rollup/scrape consumer of the series",
+            token=metric))
+    streamed = {metric for _r, _l, metric in calls}
+    for metric, line in sorted(declared.items()):
+        if metric not in streamed:
+            findings.append(Finding(
+                RULE_UNSTREAMED, stream_py_rel, line,
+                f"STREAM_METRICS declares {metric!r} but no "
+                f"stream_count/stream_observe call streams it — dead "
+                f"registry entry (or the producer was lost)",
+                token=metric))
+    return findings

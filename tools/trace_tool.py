@@ -10,6 +10,10 @@ ranks dump at finalize, then:
       merge everything into Chrome/Perfetto trace_event JSON (open the
       file in https://ui.perfetto.dev), self-validating; also folds the
       straggler aggregates back into telemetry.json unless --no-fold.
+      With --follow, tails a LIVE run instead: the trace is atomically
+      rewritten every --interval seconds from whatever spill dumps
+      (rabit_obs_spill_sec) exist so far, and the loop ends with the
+      strict final export once the job's telemetry file appears.
 
   python tools/trace_tool.py report  OBS_DIR [--top K] [--json]
                                      [--flag-links HOST:PORT]
@@ -46,12 +50,19 @@ from rabit_tpu.obs import trace  # noqa: E402
 
 
 def cmd_export(args: argparse.Namespace) -> int:
-    doc, path, report = trace.export_job(
-        args.obs_dir, out_path=args.out, fold=not args.no_fold,
-        top_k=args.top, job_key=args.job)
+    rounds = 0
+    if args.follow:
+        doc, path, report, rounds = trace.export_follow(
+            args.obs_dir, out_path=args.out, interval=args.interval,
+            fold=not args.no_fold, top_k=args.top, job_key=args.job,
+            max_rounds=args.max_rounds)
+    else:
+        doc, path, report = trace.export_job(
+            args.obs_dir, out_path=args.out, fold=not args.no_fold,
+            top_k=args.top, job_key=args.job)
     n_spans = sum(1 for e in doc["traceEvents"] if e.get("ph") == "X")
     other = doc["otherData"]
-    print(json.dumps({
+    line = {
         "trace": path,
         "ranks": other["ranks"],
         "dumps_merged": other["dumps_merged"],
@@ -59,7 +70,10 @@ def cmd_export(args: argparse.Namespace) -> int:
         "events": len(doc["traceEvents"]),
         "collectives_analyzed": report["collectives_analyzed"],
         "clock_max_err_s": other["clock_max_err_s"],
-    }))
+    }
+    if args.follow:
+        line["follow_rounds"] = rounds
+    print(json.dumps(line))
     return 0
 
 
@@ -158,6 +172,16 @@ def main(argv: list[str] | None = None) -> int:
     exp.add_argument("--no-fold", action="store_true",
                      help="do not fold straggler aggregates into "
                           "telemetry.json")
+    exp.add_argument("--follow", action="store_true",
+                     help="tail mode: atomically rewrite the trace every "
+                          "--interval seconds from the live spill dumps "
+                          "(rabit_obs_spill_sec) until the job's telemetry "
+                          "file appears, then run the final strict export")
+    exp.add_argument("--interval", type=float, default=1.0,
+                     help="seconds between follow-mode rounds")
+    exp.add_argument("--max-rounds", type=int, default=None,
+                     help="stop following after N rounds even if the job "
+                          "is still live")
     exp.set_defaults(fn=cmd_export)
 
     rep = sub.add_parser("report", help="straggler analytics")
