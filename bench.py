@@ -134,6 +134,13 @@ SERVICE_CHILD_TIMEOUT = 180.0
 # like the other riders; RABIT_BENCH_OBS=0 skips it.
 OBS_BENCH = os.environ.get("RABIT_BENCH_OBS", "1") != "0"
 OBS_CHILD_TIMEOUT = 90.0
+# Regression sentinel (ISSUE 18): every driver record carries the
+# high-water verdict over the existing BENCH_*/RESULTS trajectory
+# (tools/bench_sentinel.py), so a silent perf erasure — the r03-r05
+# TPU-goes-dark wedge — is a flagged regression in the new record
+# itself, not something a human diffs by hand.  Pure file reads, no
+# wall cost; RABIT_BENCH_SENTINEL=0 skips it.
+SENTINEL_BENCH = os.environ.get("RABIT_BENCH_SENTINEL", "1") != "0"
 FUSED_CHILD_TIMEOUT = 180.0
 FUSED_WORLD = 4
 FUSED_ELEMS = 1 << 18  # 1 MiB of f32 — the acceptance bar's payload floor
@@ -1110,6 +1117,26 @@ def parked_tpu_capture():
     return None
 
 
+def sentinel_verdict():
+    """The bench-sentinel verdict over the repo's recorded trajectory
+    (tools/bench_sentinel.py), or None when skipped/unavailable — the
+    sentinel must never fail the bench it is auditing."""
+    if not SENTINEL_BENCH:
+        return None
+    root = os.path.dirname(os.path.abspath(__file__))
+    try:
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "bench_sentinel", os.path.join(root, "tools",
+                                           "bench_sentinel.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod.verdict(root)
+    except Exception:
+        return None
+
+
 def main():
     log(f"dataset: {N_ROWS} rows x {N_FEATURES} feats, {N_BINS} bins, depth {DEPTH}")
     # Numpy baseline FIRST: it is a ~2s subsample-and-scale measurement, and
@@ -1247,6 +1274,9 @@ def main():
             rec["service"] = service_lines
         if obs_lines:
             rec["live_metrics"] = obs_lines
+        sv = sentinel_verdict()
+        if sv is not None:
+            rec["sentinel"] = sv
         print(json.dumps(rec), flush=True)
         return
     device_time = res["device_time"]
@@ -1311,6 +1341,9 @@ def main():
         rec["service"] = service_lines
     if obs_lines:
         rec["live_metrics"] = obs_lines
+    sv = sentinel_verdict()
+    if sv is not None:
+        rec["sentinel"] = sv
     print(json.dumps(rec), flush=True)
 
 

@@ -542,6 +542,12 @@ class ElasticScheduleResult:
     n_failover: int = 0               # tracker_failover promotions
     n_journal_gap: int = 0            # replay divergences (must stay 0)
     primary_killed: bool = False      # the tracker_death fault landed
+    # diagnosis plane (rabit_tpu.obs.diagnose, doc/observability.md):
+    # the active tracker's HealthMonitor exposition at schedule end —
+    # open + recent incidents and the lifetime open/resolve counters,
+    # so chaos runs can assert WHAT the monitor indicted (class and
+    # named subject), not just that repair machinery moved.
+    incidents: dict = field(default_factory=dict)
 
 
 def run_elastic_schedule(seed: int, world: int | None = None,
@@ -1102,4 +1108,5 @@ def run_elastic_schedule(seed: int, world: int | None = None,
         n_journal_gap=sum(1 for e in all_events
                           if e["kind"] == "journal_gap"),
         primary_killed=bool(getattr(tracker, "_killed", False)),
+        incidents=active_tracker._health.render(),
     )

@@ -229,6 +229,26 @@ DEFAULTS: dict[str, str] = {
     # Nagle on, every cold-direction header write stalls ~40ms behind the
     # peer's delayed ACK — measured 44ms/op on loopback object broadcasts.
     "rabit_enable_tcp_no_delay": "1",
+    # Diagnosis plane (rabit_tpu/obs/diagnose.py, doc/observability.md).
+    # rabit_diag_enable: run the HealthMonitor on the tracker (and every
+    # service partition); rabit_diag_window_sec: detection-window cadence;
+    # rabit_diag_open_windows / rabit_diag_resolve_windows: hysteresis —
+    # consecutive firing windows before an incident opens / quiet windows
+    # before it resolves; rabit_diag_min_wait_sec: ignore windows whose
+    # total link wait is below this (clean-run noise floor);
+    # rabit_diag_link_share: the degraded-link concentration threshold
+    # (top link's share of the window's wait); rabit_diag_hole_ratio:
+    # the compute-straggler hole threshold (the quiet link's wait vs the
+    # per-link mean); rabit_diag_storm_leases: lease expiries across the
+    # recent windows that count as a preemption storm, not one death.
+    "rabit_diag_enable": "1",
+    "rabit_diag_window_sec": "0.5",
+    "rabit_diag_open_windows": "2",
+    "rabit_diag_resolve_windows": "4",
+    "rabit_diag_min_wait_sec": "0.05",
+    "rabit_diag_link_share": "0.5",
+    "rabit_diag_hole_ratio": "0.25",
+    "rabit_diag_storm_leases": "3",
 }
 
 

@@ -66,8 +66,10 @@ from typing import Callable
 import numpy as np
 
 from rabit_tpu.elastic.rebalance import refold
-from rabit_tpu.obs.ship import Heartbeat, renew_lease
-from rabit_tpu.obs.stream import stream_observe
+from rabit_tpu.obs.metrics import MetricsRegistry
+from rabit_tpu.obs.ship import (Heartbeat, build_snapshot, renew_lease,
+                                ship_snapshot)
+from rabit_tpu.obs.stream import DeltaSource, stream_observe
 from rabit_tpu.tracker import protocol as P
 
 
@@ -197,6 +199,14 @@ class ElasticWorker:
         self._ring_next = -1
         self._wait_total_s = 0.0   # across all epochs (ElasticResult)
         self._epoch_wait_s = 0.0
+        # Per-worker streamed-metrics registry (doc/observability.md):
+        # chaos schedules and tests run many workers per process, so the
+        # ring-wait series must not alias in the process-global registry;
+        # the heartbeat tick piggybacks each window's delta (CMD_METRICS)
+        # so the tracker's live rollup — and the diagnosis plane reading
+        # it — sees this worker's link waits while the job runs.
+        self._metrics_reg = MetricsRegistry()
+        self._delta_src = DeltaSource(self._metrics_reg)
         self._epoch_started = 0.0
         self._epoch_reported = False
         self._n_slow_reports = 0
@@ -570,6 +580,7 @@ class ElasticWorker:
             # plane (doc/observability.md): the route-around loop reads
             # these (src -> dst) health series from the tracker scrape.
             stream_observe("link_wait_seconds", wait,
+                           registry=self._metrics_reg,
                            src=self._ring_prev, dst=asg.rank)
             # the block s steps behind THIS POSITION in the planned ring
             blocks[self._order[(self._pos - 1 - step) % world]] = incoming
@@ -943,8 +954,24 @@ class ElasticWorker:
         def tick() -> bool:
             if self._stop.is_set():
                 return False
-            return renew_lease(host, port, self.task_id, self.heartbeat_sec,
-                               rank=self._rank, addrs=self.addrs)
+            ok = renew_lease(host, port, self.task_id, self.heartbeat_sec,
+                             rank=self._rank, addrs=self.addrs)
+            # Piggyback the window's streamed-metrics delta on the lease
+            # cadence (best-effort, like every obs ship).  Deferred until
+            # a rank is assigned: the tracker rejects out-of-range ranks
+            # at ingest, and an untaken delta simply ships after
+            # promotion — no window is consumed-and-dropped while parked.
+            rank = self._rank
+            if rank >= 0:
+                delta = self._delta_src.take()
+                if delta:
+                    snap = build_snapshot(self._metrics_reg, rank,
+                                          self.task_id,
+                                          extra={"delta": delta})
+                    ship_snapshot(snap, host, port, self.task_id,
+                                  timeout=max(self.heartbeat_sec, 0.2),
+                                  addrs=self.addrs)
+            return ok
 
         self._hb = Heartbeat(self.heartbeat_sec, tick, immediate=True).start()
 

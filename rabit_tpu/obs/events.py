@@ -150,6 +150,15 @@ KINDS: dict[str, str] = {
                          "avoided, residual",
     "link_degraded": "worker slow_link report (from prints): src, dst, "
                      "wait, share",
+    # diagnosis plane (rabit_tpu/obs/diagnose.py, doc/observability.md)
+    "incident_opened": "HealthMonitor opened an incident: incident, "
+                       "class, + the subject fields (src/dst, rank, "
+                       "relay...)",
+    "incident_resolved": "an open incident went quiet past the "
+                         "hysteresis bar: incident, class, + subject",
+    "critical_path_folded": "trace_tool diagnose folded a critical-path "
+                            "report into telemetry.json: rounds, links, "
+                            "ranks",
 }
 
 

@@ -119,6 +119,17 @@ def render(doc: dict, prev: dict | None = None, top_links: int = 6) -> str:
             f"completed={svc.get('completed')} "
             f"pool_parked={svc.get('pool_parked')} "
             f"auto_world={svc.get('auto_world')}")
+    # incidents pane (diagnosis plane, doc/observability.md): every open
+    # incident across the jobs, newest-evidence fields inline
+    incidents = doc.get("incidents")
+    if isinstance(incidents, dict) and incidents.get("open"):
+        lines.append(f"incidents: {incidents.get('n_open', 0)} open")
+        for inc in incidents["open"]:
+            subject = " ".join(f"{k}={v}" for k, v in
+                               sorted((inc.get("subject") or {}).items()))
+            lines.append(f"  [{inc.get('class')}] {inc.get('id')} "
+                         f"job={inc.get('job') or '-'} {subject} "
+                         f"({inc.get('windows', 0)}w)")
 
     prev_jobs = {key: j for _t, key, j in _job_rows(prev)} if prev else {}
     lines.append(f"{'tenant':<10} {'job':<12} {'ep':>3} {'world':>5} "
@@ -197,6 +208,13 @@ def main(argv: list[str] | None = None) -> int:
                          job=args.job, registry=args.registry)
             rtt_ms = (time.perf_counter() - t0) * 1e3
             polls += 1
+            if doc.get("schema") != obs_stream.STREAM_SCHEMA:
+                # the exposition schema is the contract downstream
+                # pollers gate on — refuse to mis-render a foreign one
+                # (--json consumers read the stamp from the doc itself)
+                print(f"unsupported scrape schema {doc.get('schema')!r} "
+                      f"(want {obs_stream.STREAM_SCHEMA})", file=sys.stderr)
+                return 3
             if args.json:
                 print(json.dumps(doc, sort_keys=True), flush=True)
             else:

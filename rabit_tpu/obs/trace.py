@@ -176,6 +176,25 @@ def telemetry_name(job_key: str = "") -> str:
     return f"telemetry-{job_key}.json" if job_key else "telemetry.json"
 
 
+_TELE_RE = re.compile(r"^telemetry-(?P<job>.+)\.json$")
+
+
+def discover_telemetry_jobs(obs_dir: str) -> list[str]:
+    """The job keys whose per-job telemetry files exist under a shared
+    multi-tenant obs dir (``telemetry-<job>.json``), sorted.  The bare
+    legacy ``telemetry.json`` is NOT a job — callers check it first."""
+    try:
+        names = sorted(os.listdir(obs_dir))
+    except OSError:
+        return []
+    out = []
+    for name in names:
+        m = _TELE_RE.match(name)
+        if m:
+            out.append(m.group("job"))
+    return out
+
+
 def load_job(obs_dir: str, job_key: str = "",
              tolerant: bool = False) -> JobTrace:
     """Join every flight dump + telemetry.json under ``obs_dir``.
@@ -350,6 +369,7 @@ _TRACKER_INSTANTS = {
     "job_admitted", "admission_refused", "worker_leased",
     "job_completed",
     "obs_scrape", "metrics_delta_folded",
+    "incident_opened", "incident_resolved", "critical_path_folded",
 }
 
 
@@ -717,10 +737,20 @@ def export_follow(obs_dir: str, out_path: str | None = None,
     tele_path = os.path.join(obs_dir, telemetry_name(job_key))
     rounds = 0
     while True:
+        final_key = job_key
         finished = os.path.exists(tele_path)
+        if not finished and not job_key:
+            # Multi-tenant dirs never produce the bare legacy name: a
+            # service job lands as telemetry-<job>.json, so a bare-key
+            # follow adopts the first finished job's key and finalizes
+            # against it (consistent with ``trace_tool export --job``).
+            jobs = discover_telemetry_jobs(obs_dir)
+            if jobs:
+                final_key, finished = jobs[0], True
         if finished:
             doc, out_path, report = export_job(
-                obs_dir, out_path, fold=fold, top_k=top_k, job_key=job_key)
+                obs_dir, out_path, fold=fold, top_k=top_k,
+                job_key=final_key)
             return doc, out_path, report, rounds + 1
         job = load_job(obs_dir, job_key=job_key, tolerant=True)
         doc = build_chrome_trace(job)

@@ -61,7 +61,7 @@ from rabit_tpu.obs import stream as obs_stream
 from rabit_tpu.service.registry import JobRegistry, tenant_of
 from rabit_tpu.service.state import ServiceState
 from rabit_tpu.tracker import protocol as P
-from rabit_tpu.tracker.tracker import Tracker
+from rabit_tpu.tracker.tracker import Tracker, _aggregate_incidents
 
 #: Route-key prefix of one pooled worker: "pool/<name>".
 _POOL_ROUTE = P.POOL_PREFIX + P.JOB_SEP
@@ -538,6 +538,12 @@ class CollectiveService(Tracker):
                     tenant["wire_bytes"].get(codec, 0) + n)
                 tenant["wire_bytes_total"] += n
         doc["tenants"] = tenants
+        # Re-aggregate the top-level incidents digest over EVERY job doc
+        # (the super() pass only saw the service's own legacy section).
+        all_jobs = dict(doc["jobs"])
+        for tenant in tenants.values():
+            all_jobs.update(tenant["jobs"])
+        doc["incidents"] = _aggregate_incidents(all_jobs)
         return doc
 
     # -- lifecycle -----------------------------------------------------------

@@ -106,6 +106,7 @@ from typing import Callable
 from rabit_tpu import sched
 from rabit_tpu.config import Config
 from rabit_tpu.elastic.membership import CLOSE, MembershipManager
+from rabit_tpu.obs import diagnose as obs_diagnose
 from rabit_tpu.obs import stream as obs_stream
 from rabit_tpu.obs.events import event_from_stats_line
 from rabit_tpu.obs.metrics import GLOBAL_REGISTRY
@@ -114,6 +115,19 @@ from rabit_tpu.tracker import protocol as P
 
 #: telemetry.json envelope version (bump on incompatible change).
 TELEMETRY_SCHEMA = 1
+
+
+def _aggregate_incidents(jobs: dict) -> dict:
+    """The scrape's top-level incidents digest: every job's open
+    incidents flattened (job-stamped) so a poller reads one section
+    regardless of tenancy.  A CollectiveService rebuilds this after
+    merging its tenants' job docs."""
+    open_inc: list[dict] = []
+    for job_key, jdoc in sorted(jobs.items()):
+        for inc in ((jdoc.get("incidents") or {}).get("open") or ()):
+            open_inc.append({**inc, "job": job_key})
+    return {"schema": obs_diagnose.DIAG_SCHEMA,
+            "n_open": len(open_inc), "open": open_inc}
 
 
 @dataclass
@@ -408,6 +422,14 @@ class Tracker:
         # CMD_OBS batch frames) fold into per-rank/per-job rollups that a
         # CMD_OBS scrape renders live, without touching a worker.
         self._stream = obs_stream.StreamRollup()
+        # Diagnosis plane (doc/observability.md): the HealthMonitor
+        # evaluates detection windows over the rollup + control-plane
+        # deltas from the lease-monitor thread (never the reactor) and
+        # opens/resolves structured incidents; confirmed degraded-link
+        # incidents feed the avoid-set repair machinery (_flag_link).
+        self._health = obs_diagnose.HealthMonitor()
+        self._diag_next = 0.0   # monotonic deadline of the next window
+        self._diag_ev_idx = 0   # events already consumed by past windows
         self._delta_ranks: set[str] = set()  # first-fold evidence, per rank
         self._obs_scraped = False  # first-scrape evidence (one event)
         self.telemetry: dict | None = None
@@ -981,7 +1003,16 @@ class Tracker:
                 self.events.append(
                     {"ts": round(ev.ts, 6), "kind": ev.kind,
                      **ev.fields})
-            if ev.kind == "link_degraded":
+            # Live worker self-reports no longer flag the link
+            # directly: the event feeds the HealthMonitor (_diag_tick),
+            # which attributes + hysteresis-gates the signal and calls
+            # flag_link when the degraded-link incident opens
+            # (doc/observability.md, "Diagnosis plane").  Reports with
+            # an explicit origin= stamp (trace_tool --flag-links, the
+            # offline analytics half of doc/scheduling.md's repair
+            # policy) are operator decisions, not symptoms — they keep
+            # the direct path.
+            if ev.kind == "link_degraded" and ev.fields.get("origin"):
                 self._flag_link(ev.fields)
         if not self.quiet:
             print(msg, end="" if msg.endswith("\n") else "\n", flush=True)
@@ -1954,6 +1985,7 @@ class Tracker:
                                  - self._shutdown_tasks))
             if done:
                 self._finalize_done()
+        self._diag_tick(now)
 
     def live_tasks(self) -> list[str]:
         """Task ids currently holding an unexpired lease."""
@@ -1990,6 +2022,56 @@ class Tracker:
                         "rank": str(rank),
                     })
 
+    def _diag_tick(self, now: float) -> None:
+        """One diagnosis window (``rabit_diag_window_sec`` cadence), run
+        from the lease-monitor thread — a service ticks every partition's
+        monitor through its ``_lease_tick`` override.  State is copied
+        under the lock, the rules evaluate OUTSIDE it (HealthMonitor has
+        its own leaf lock; the rollup render takes its own), and the
+        repair feed fires with no lock held (flag_link locks)."""
+        hm = self._health
+        if not hm.enabled or now < self._diag_next:
+            return
+        self._diag_next = now + hm.window_sec
+        with self._lock:
+            events_delta = self.events[self._diag_ev_idx:]
+            self._diag_ev_idx = len(self.events)
+            dropped = self.messages_dropped
+        stream_doc = self._stream.render()
+        opened, resolved = hm.observe(now, stream_doc,
+                                      {"events_delta": events_delta,
+                                       "messages_dropped": dropped})
+        if not opened and not resolved:
+            return
+        ts = round(time.time(), 6)
+        with self._lock:
+            for inc in opened:
+                self.events.append({"ts": ts, "kind": "incident_opened",
+                                    "incident": inc.incident_id,
+                                    "class": inc.cls, **inc.subject})
+            for inc in resolved:
+                self.events.append({"ts": ts, "kind": "incident_resolved",
+                                    "incident": inc.incident_id,
+                                    "class": inc.cls, **inc.subject})
+        for inc in opened:
+            if not self.quiet:
+                print(f"[tracker] incident opened: {inc.incident_id} "
+                      f"{inc.subject}", flush=True)
+            if inc.cls == "degraded-link":
+                # The attributed, hysteresis-confirmed repair signal —
+                # same avoid-set machinery as a worker slow_link report,
+                # minus the one-report-per-epoch guesswork.
+                try:
+                    src = int(inc.subject.get("src"))
+                    dst = int(inc.subject.get("dst"))
+                except (TypeError, ValueError):
+                    continue
+                self.flag_link(src, dst)
+        for inc in resolved:
+            if not self.quiet:
+                print(f"[tracker] incident resolved: {inc.incident_id} "
+                      f"after {inc.windows} window(s)", flush=True)
+
     def _scrape_job_state(self) -> dict:
         """One job's live scrape section, assembled from already-locked
         copies of control state (never file IO): membership, leases, the
@@ -2017,6 +2099,7 @@ class Tracker:
         # The rollup carries its own leaf lock; render it OUTSIDE
         # self._lock (lock-order discipline, doc/static_analysis.md).
         live["stream"] = self._stream.render()
+        live["incidents"] = self._health.render()
         return live
 
     def build_scrape(self, opts: dict | None = None) -> dict:
@@ -2037,6 +2120,7 @@ class Tracker:
                         **serve},
             "jobs": {self.job or "": self._scrape_job_state()},
         }
+        doc["incidents"] = _aggregate_incidents(doc["jobs"])
         if opts.get("registry", True):
             doc["registry"] = GLOBAL_REGISTRY.snapshot()
         return doc
@@ -2097,6 +2181,7 @@ class Tracker:
         # a scrape taken mid-run and the shutdown telemetry.json agree
         # byte-for-byte on every fully-folded cumulative counter.
         stream_rollup = self._stream.render()
+        incidents = self._health.render()
         waves = [e for e in events if e["kind"] == "wave"]
         # Per-rank clock-offset estimates (tracker_ts = worker_ts +
         # offset_s), shipped inside snapshots; the trace merger uses these
@@ -2147,6 +2232,7 @@ class Tracker:
             "restarts": restarts,
             "clocks": clocks,
             "stream": stream_rollup,
+            "incidents": incidents,
             "waves": waves,
             "events": events,
             "ranks": snapshots,

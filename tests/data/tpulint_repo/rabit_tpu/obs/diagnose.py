@@ -1,0 +1,14 @@
+"""tpulint fixture: the diagnosis plane's two stringly-typed surfaces.
+
+The real HealthMonitor (rabit_tpu/obs/diagnose.py) emits incident
+events as dict literals and reads its hysteresis knobs through
+``cfg.get*`` — both silent-failure-on-typo channels.  One seed per
+surface: a typo'd incident kind (the dict-literal emission pattern the
+registry family recognizes) and a typo'd ``rabit_diag_*`` key read.
+"""
+
+
+def open_incident(events, cfg):
+    window = cfg.get("rabit_diag_windw_sec", "0.5")  # SEEDED: config-key-unknown
+    events.append({"kind": "incidnet_opened", "window": window})  # SEEDED: event-kind-unregistered
+    return events

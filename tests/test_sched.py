@@ -329,16 +329,19 @@ def test_e2e_swing_plan_executes_bitwise(tmp_path):
 def test_e2e_slow_link_repair_drops_wait():
     """The acceptance A/B: the same chaos slow_link schedule run with
     repair off then on.  With repair, the dst worker reports the link,
-    the tracker replans at the next epoch boundary, and the dst's
-    cumulative link wait drops; bits stay closed-form in both arms
-    (asserted inside run_elastic_schedule)."""
-    link = (1, 2, 0.1)
+    the HealthMonitor confirms the report over its hysteresis windows
+    (the incident feed, doc/observability.md), the tracker replans at
+    the next epoch boundary, and the dst's cumulative link wait drops;
+    bits stay closed-form in both arms (asserted inside
+    run_elastic_schedule).  The schedule is long enough that the
+    detection latency (~2 x rabit_diag_window_sec) is amortized."""
+    link = (1, 2, 0.15)
     off = run_elastic_schedule(11, world=3, schedule="ring",
-                               slow_link=link, repair=False, niter=7,
-                               deadline_sec=45.0)
+                               slow_link=link, repair=False, niter=12,
+                               deadline_sec=60.0)
     on = run_elastic_schedule(11, world=3, schedule="ring",
-                              slow_link=link, repair=True, niter=7,
-                              deadline_sec=45.0)
+                              slow_link=link, repair=True, niter=12,
+                              deadline_sec=60.0)
     assert off.outcome == on.outcome == "completed"
     assert off.n_repaired == 0
     assert on.n_repaired >= 1

@@ -58,6 +58,11 @@ def seeded_line(relpath: str, rule: str) -> int:
     # family 3b: streamed-metric registry (live telemetry plane)
     ("stream-metric-unregistered", "rabit_tpu/store.py"),
     ("stream-metric-unstreamed", "rabit_tpu/obs/stream.py"),
+    # diagnosis plane (ISSUE 18): the HealthMonitor's two stringly-typed
+    # surfaces — a typo'd incident-kind emission (dict-literal pattern)
+    # and a typo'd rabit_diag_* hysteresis-knob read
+    ("event-kind-unregistered", "rabit_tpu/obs/diagnose.py"),
+    ("config-key-unknown", "rabit_tpu/obs/diagnose.py"),
     # family 4: wire-protocol symmetry
     ("wire-cmd-mismatch", "rabit_tpu/tracker/protocol.py"),
     ("wire-cmd-unhandled", "rabit_tpu/tracker/protocol.py"),

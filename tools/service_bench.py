@@ -25,7 +25,9 @@ the recovery_bench/chaos harness shape):
   CMD_OBS scraper polling the service plus a follow-mode trace exporter
   tailing the periodic flight spills, asserting job wall-clocks and boot
   p99 stay within ``--obs-bar`` (default 1.05x) of the unobserved clean
-  arm — observation must be provably cheap.
+  arm — observation must be provably cheap — and that the diagnosis
+  plane (HealthMonitor, doc/observability.md) opens ZERO incidents on
+  the clean fleet: the false-positive gate.
 
 Every record is one JSON line with ``"bench": "service"`` (the bench.py
 driver embeds them under ``rec["service"]``; RABIT_BENCH_SERVICE=0
@@ -332,7 +334,8 @@ def bench_service(n_jobs: int, world: int, niter: int, sleep: float,
         fleet = [JobRun(f"obs{i}", world, niter, sleep, addr_for(i),
                         deadline) for i in range(n_jobs)]
         stop = threading.Event()
-        scr = {"n": 0, "errors": 0, "lat": [], "live_max": 0}
+        scr = {"n": 0, "errors": 0, "lat": [], "live_max": 0,
+               "incidents_max": 0}
         follow = {"rounds": 0, "events": 0, "error": ""}
 
         def scraper():
@@ -345,6 +348,9 @@ def bench_service(n_jobs: int, world: int, niter: int, sleep: float,
                     scr["live_max"] = max(
                         scr["live_max"],
                         len(doc.get("service", {}).get("live", [])))
+                    scr["incidents_max"] = max(
+                        scr["incidents_max"],
+                        int(doc.get("incidents", {}).get("n_open", 0)))
                 except Exception:  # noqa: BLE001 — observation is best-effort
                     scr["errors"] += 1
                 stop.wait(1.0 / max(scrape_hz, 0.1))
@@ -389,6 +395,7 @@ def bench_service(n_jobs: int, world: int, niter: int, sleep: float,
                    scrapes=scr["n"], scrape_errors=scr["errors"],
                    scrape_p99_ms=round(pctl(scr["lat"], 99) * 1e3, 3),
                    live_jobs_max=scr["live_max"],
+                   incidents_open_max=scr["incidents_max"],
                    follow_rounds=follow["rounds"],
                    follow_trace_events=follow["events"],
                    follow_error=follow["error"],
@@ -400,6 +407,9 @@ def bench_service(n_jobs: int, world: int, niter: int, sleep: float,
             f"observed arm: scraper failed ({scr['errors']} error(s))"
         assert not follow["error"], \
             f"observed arm: follow exporter failed: {follow['error']}"
+        assert scr["incidents_max"] == 0, (
+            f"observed arm: HealthMonitor opened {scr['incidents_max']} "
+            f"incident(s) on a CLEAN run — diagnosis false positive")
         if assert_isolation:
             assert ratios and max(ratios) <= obs_bar, (
                 f"observed arm: job wall-clock {max(ratios):.3f}x its "

@@ -27,6 +27,16 @@ ranks dump at finalize, then:
       next epoch boundary — previously repair only triggered from
       worker self-reports.
 
+  python tools/trace_tool.py diagnose OBS_DIR [--top K] [--json] [--fold]
+      per-round critical-path postmortem (rabit_tpu/obs/critical.py):
+      classifies every collective round as compute-gated (entry skew —
+      the last-entering rank), link-gated (excess drain — the slowest
+      in-collective rank's incoming planned-ring link), or balanced;
+      reports top gating ranks/links (joined with the streamed
+      link_wait_seconds rollup) and recovery-wave cost accounting.
+      --fold writes the report into telemetry.json under
+      ``critical_path`` and stamps a ``critical_path_folded`` event.
+
   python tools/trace_tool.py validate TRACE_JSON
       structural check of an exported trace against the trace_event
       schema subset this exporter emits.
@@ -143,6 +153,44 @@ def cmd_report(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_diagnose(args: argparse.Namespace) -> int:
+    from rabit_tpu.obs import critical
+
+    job = trace.load_job(args.obs_dir, job_key=args.job)
+    report = critical.critical_path_report(job, margin_sec=args.margin,
+                                           top_k=args.top)
+    if args.fold:
+        critical.fold_critical_path(args.obs_dir, report, job_key=args.job)
+    if args.json:
+        print(json.dumps(report, indent=1, sort_keys=True))
+        return 0
+    gates = report["rounds_by_gate"]
+    print(f"rounds: {report['rounds_analyzed']} analyzed "
+          f"(compute-gated {gates['compute']}, link-gated {gates['link']}, "
+          f"balanced {gates['balanced']}), "
+          f"{report['rounds_recovery_affected']} recovery-affected "
+          f"of {report['rounds_total']} total")
+    print(f"latency: {report['latency_total_s']*1e3:.3f} ms across analyzed "
+          f"rounds (base drain {report['base_drain_s']*1e3:.3f} ms/round, "
+          f"entry skew {report['entry_skew_total_s']*1e3:.3f} ms total)")
+    if report["top_gating_ranks"]:
+        print("top gating ranks (compute critical path):")
+        for r in report["top_gating_ranks"]:
+            print(f"  rank {r['rank']}: gated {r['rounds']} round(s), "
+                  f"cost {r['cost_s']*1e3:.3f} ms")
+    if report["top_gating_links"]:
+        print("top gating links (ring critical path):")
+        for l in report["top_gating_links"]:
+            streamed = (f", streamed wait {l['streamed_wait_s']*1e3:.3f} ms"
+                        if "streamed_wait_s" in l else "")
+            print(f"  link {l['src']}->{l['dst']}: gated {l['rounds']} "
+                  f"round(s), cost {l['cost_s']*1e3:.3f} ms{streamed}")
+    if report["recovery_waves"]:
+        print(f"recovery waves: {len(report['recovery_waves'])}, total "
+              f"cost {report['recovery_cost_s']*1e3:.3f} ms")
+    return 0
+
+
 def cmd_validate(args: argparse.Namespace) -> int:
     with open(args.trace_json) as f:
         doc = json.load(f)
@@ -199,6 +247,22 @@ def main(argv: list[str] | None = None) -> int:
     rep.add_argument("--wait-share", type=float, default=0.5,
                      help="lateness-share threshold for --flag-links")
     rep.set_defaults(fn=cmd_report)
+
+    diag = sub.add_parser("diagnose",
+                          help="per-round critical-path postmortem")
+    diag.add_argument("obs_dir")
+    diag.add_argument("--job", default="", metavar="KEY",
+                      help="select one job of a multi-job obs dir "
+                           "(reads telemetry-KEY.json; doc/service.md)")
+    diag.add_argument("--top", type=int, default=3)
+    diag.add_argument("--margin", type=float, default=0.02,
+                      help="noise margin in seconds below which a round "
+                           "is balanced (default 0.02)")
+    diag.add_argument("--json", action="store_true")
+    diag.add_argument("--fold", action="store_true",
+                      help="fold the report into telemetry.json under "
+                           "critical_path")
+    diag.set_defaults(fn=cmd_diagnose)
 
     val = sub.add_parser("validate", help="validate an exported trace")
     val.add_argument("trace_json")
