@@ -794,8 +794,11 @@ class ElasticWorker:
                held: list[tuple[int, int]]) -> dict | None:
         """One CMD_QUORUM report; returns the parsed reply or None on a
         transport miss (the caller's bounded loop retries)."""
+        # canonical JSON (sorted keys, fixed separators): the report is
+        # wire bytes on a contract path — tools/tpulint determinism
         msg = json.dumps({"epoch": asg.epoch, "v": v, "have": have,
-                          "held": [list(t) for t in held]})
+                          "held": [list(t) for t in held]},
+                         sort_keys=True, separators=(",", ":"))
         try:
             reply = P.tracker_rpc(self.tracker[0], self.tracker[1],
                                   P.CMD_QUORUM, self.task_id,

@@ -230,6 +230,28 @@ CMD_OBS = 14
 #: the relay channel).
 ROUTE_CLOSE = 1
 
+#: Serving-path asymmetries that are DESIGN, not drift — the machine-
+#: checked ledger behind tools/tpulint's serving-path-parity family
+#: (doc/static_analysis.md).  Every command served at one of the three
+#: serving paths (threaded handler, shared reactor, relay batch fold)
+#: must be served at the others OR declared here with the reason; the
+#: lint also flags entries whose asymmetry no longer exists, so this
+#: table cannot rot silently.
+PARITY_EXEMPT = {
+    "relay-fold": {
+        "CMD_EPOCH": "never rides a batch: the relay answers epoch polls "
+                     "from its ack-refreshed cache (doc/scaling.md)",
+        "CMD_BLOB": "proxied straight through by the relay: rank-0 blob "
+                    "uploads are large and rare, they keep the "
+                    "synchronous path",
+        "CMD_BATCH": "a batch cannot nest inside a batch: the envelope "
+                     "is the relay channel itself",
+        "CMD_JOURNAL": "standby trackers tail the journal over a direct "
+                       "socket, never through a worker relay "
+                       "(doc/ha.md)",
+    },
+}
+
 #: How many renewal intervals a lease survives without a renewal.  2 means
 #: one lost/late heartbeat is tolerated; the second expires the lease, so a
 #: frozen worker is suspected within 2 x rabit_heartbeat_sec.

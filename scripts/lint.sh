@@ -12,19 +12,20 @@ cd "$(dirname "$0")/.."
 python -m compileall -q rabit_tpu rabit_tpu/obs rabit_tpu/compress rabit_tpu/elastic rabit_tpu/sched rabit_tpu/quorum rabit_tpu/relay rabit_tpu/ha rabit_tpu/service rabit_tpu/obs/stream.py rabit_tpu/obs/top.py rabit_tpu/obs/trace.py rabit_tpu/obs/diagnose.py rabit_tpu/obs/critical.py rabit_tpu/chaos.py rabit_tpu/engine/fused.py tests guide tools tools/trace_tool.py tools/obs_top.py tools/service_bench.py tools/bench_sentinel.py bench.py __graft_entry__.py
 
 # tpulint (doc/static_analysis.md): lock discipline, event-kind registry,
-# config-key discipline, wire-protocol symmetry, plus the interprocedural
+# config-key discipline, wire-protocol symmetry, the interprocedural
 # v2 families (reactor-blocking, journal-coverage, lock-order,
-# thread-ownership).  Fails on any finding not carried (with a
-# justification) in tools/tpulint/baseline.json — and on blowing the
-# wall-time budget, which keeps the whole-repo call-graph pass honest as
-# the tree grows.
+# thread-ownership), and the dataflow-substrate v3 families (resources,
+# determinism, serving-parity).  Fails on any finding not carried (with
+# a justification) in tools/tpulint/baseline.json — and on blowing the
+# wall-time budget, which keeps the whole-repo pass honest as the tree
+# grows; --timings attributes the budget per family.
 python - <<'EOF'
 import sys, time
 from tools.tpulint.__main__ import main
 
 BUDGET_SEC = 15.0
 t0 = time.monotonic()
-rc = main([])
+rc = main(["--timings"])
 dt = time.monotonic() - t0
 print(f"tpulint wall time: {dt:.2f}s (budget {BUDGET_SEC:.0f}s)")
 if rc == 0 and dt > BUDGET_SEC:

@@ -1,9 +1,17 @@
-"""tpulint fixture: journal kind-catalogue closure (ControlState side).
+"""tpulint fixture: journal kind-catalogue closure (ControlState side)
+plus the determinism-family seeds on the snapshot encode path.
 
 ``_apply_lease`` pairs with the fixture tracker's ``_journal("lease")``
-append (the healthy case); ``_apply_orphan`` has no producer anywhere —
-the rename-drift shape ``journal-apply-dead`` must catch.
-"""
+append (the healthy case); ``_apply_halt`` pairs with the parity
+Tracker's arms; ``_apply_orphan`` has no producer anywhere — the
+rename-drift shape ``journal-apply-dead`` must catch.
+
+``snapshot_bytes`` is a bitwise-contract root (tools/tpulint
+determinism family): its encode helper seeds all three determinism
+rules."""
+
+import json
+import time
 
 
 class ControlState:
@@ -19,5 +27,22 @@ class ControlState:
     def _apply_lease(self, fields):
         self.leases[str(fields["task_id"])] = 1
 
+    def _apply_halt(self, fields):
+        self.leases.clear()
+
     def _apply_orphan(self, fields):  # SEEDED: journal-apply-dead
         self.leases.clear()
+
+    # -- bitwise-contract encode path (determinism seeds) ------------------
+
+    def snapshot_bytes(self):
+        return self._encode_snapshot()
+
+    def _encode_snapshot(self):
+        blob = json.dumps(self.leases)  # SEEDED: determinism-unsorted-json
+        dirty = set(self.leases)
+        parts = []
+        for k in dirty:  # SEEDED: determinism-unordered-iter
+            parts.append(k)
+        stamp = time.time()
+        return f"{blob}|{stamp}|{','.join(parts)}".encode()  # SEEDED: determinism-impure-taint

@@ -1,0 +1,45 @@
+"""tpulint fixture: resource-lifecycle seeds plus a dead routing arm.
+
+The three resource shapes the dataflow lifecycle analysis must catch:
+a handle that never reaches close() (normal-path leak), one whose
+release an intervening call can raise past (exception-path leak), and
+one stored on the instance that no method of the class ever tears
+down.  ``Relay._dispatch_child`` is a routing refinement surface — its
+``CMD_GHOST`` arm routes a command no serving path handles."""
+
+import socket
+
+from rabit_tpu.tracker.protocol import CMD_GHOST
+
+
+def open_probe(host):
+    s = socket.socket()  # SEEDED: resource-leak
+    s.connect((host, 9))
+    s.sendall(b"probe")
+    return True
+
+
+def fetch_blob(host):
+    s = socket.socket()  # SEEDED: resource-exc-leak
+    s.connect((host, 9))  # can raise past the close below
+    data = s.recv(1024)
+    s.close()
+    return data
+
+
+class ChannelCache:
+    """Holds its socket forever: the class-level unreleased seed."""
+
+    def __init__(self, host):
+        self._sock = socket.socket()  # SEEDED: resource-self-unreleased
+        self._sock.connect((host, 9))
+
+    def ping(self):
+        self._sock.sendall(b"p")
+
+
+class Relay:
+    def _dispatch_child(self, m):
+        if m.cmd == CMD_GHOST:  # SEEDED: parity-route-dead
+            return None
+        return m

@@ -4,6 +4,17 @@ import struct
 
 CMD_START = 1  # SEEDED: wire-cmd-mismatch (comm.h says kCmdStart = 2)
 CMD_PING = 7  # SEEDED: wire-cmd-unhandled (no tracker branch)
+CMD_WAVE = 20  # SEEDED: parity-cmd-unserved (threaded-only, not exempt)
+CMD_HALT = 21
+CMD_GHOST = 22
+
+#: serving-path asymmetry ledger (see the real protocol.py) — the
+#: reactor DOES serve CMD_HALT, so this entry is the stale-exempt seed.
+PARITY_EXEMPT = {
+    "reactor": {
+        "CMD_HALT": "outdated: the reactor grew a halt arm",  # SEEDED: parity-exempt-stale
+    },
+}
 
 _HDR = struct.Struct("<II")  # packed below, never unpacked
 

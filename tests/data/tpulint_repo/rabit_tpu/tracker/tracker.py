@@ -8,7 +8,16 @@ Each ``SEEDED:`` comment marks the exact line a finding must name.
 import threading
 import time
 
-from rabit_tpu.tracker.protocol import CMD_START
+from rabit_tpu.tracker.protocol import (
+    CMD_GHOST,
+    CMD_HALT,
+    CMD_START,
+    CMD_WAVE,
+)
+
+#: relayed-only command (referenced so the wire family stays quiet: the
+#: parity-route-dead seed is that NO serving path has an arm for it).
+_RELAY_ONLY = (CMD_GHOST,)
 
 
 class Registrar:
@@ -84,3 +93,47 @@ class Reactor:
         with self._aux_lock:
             with self._lock:  # SEEDED: lock-order-cycle
                 return self._cursor
+
+
+class Tracker:
+    """serving-path-parity seeds: three dispatch surfaces over one
+    command set.  CMD_START is served (identically) at all three;
+    CMD_WAVE only at the threaded path with no exemption; CMD_HALT at
+    all three but the reactor arm skips the journal append the other
+    two make."""
+
+    def _journal(self, kind, **fields):
+        return (kind, fields)
+
+    def _admit(self, conn):
+        return conn
+
+    # -- threaded per-connection handler -----------------------------------
+
+    def _handle(self, conn, cmd):
+        if cmd == CMD_START:
+            return self._admit(conn)
+        if cmd == CMD_WAVE:
+            return "wave"
+        if cmd == CMD_HALT:
+            self._journal("halt")
+            return "halt"
+        return None
+
+    # -- shared-reactor read callback --------------------------------------
+
+    def _reactor_read(self, rc, cmd):
+        if cmd == CMD_START:
+            return self._admit(rc)
+        if cmd == CMD_HALT:  # SEEDED: parity-side-effect-divergence
+            return "halt"  # no _journal("halt"): the divergence
+        return None
+
+    # -- relay batch fold ---------------------------------------------------
+
+    def _fold_batch_msg(self, channel, m):
+        if m.cmd == CMD_START:
+            return self._admit(m)
+        if m.cmd == CMD_HALT:
+            self._journal("halt")
+        return None

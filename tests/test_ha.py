@@ -305,6 +305,53 @@ def test_snapshot_compaction_round_trip(tmp_path):
     j.close()
 
 
+_HASHSEED_SCRIPT = """\
+import sys
+from rabit_tpu.ha import replay
+from rabit_tpu.tracker import protocol as P
+
+records = [
+    ("init", {"base_world": 4}),
+    ("wave", {"epoch": 1, "world": 4,
+              "rank_map": {"a": 0, "b": 1, "c": 2, "d": 3},
+              "started": ["a", "b"], "promoted": []}),
+    ("lease", {"task_id": "a", "interval": 2.5, "rank": 0}),
+    ("lease", {"task_id": "c", "interval": 2.5, "rank": 2}),
+    ("shutdown", {"task_id": "b"}),
+]
+st = replay(records)
+asg = P.Assignment(rank=1, world_size=4, parent=0, children=[2, 3],
+                   ring_prev=0, ring_next=2,
+                   peers={0: ("h0", 1), 1: ("h1", 2),
+                          2: ("h2", 3), 3: ("h3", 4)},
+                   epoch=3, rank_map={"a": 0, "b": 1, "c": 2, "d": 3},
+                   algo="ring", ring_order=[0, 1, 2, 3])
+sys.stdout.buffer.write(st.snapshot_bytes() + b"|" + asg.encode())
+"""
+
+
+def test_replay_and_assignment_bytes_survive_hashseed():
+    """The determinism contract (doc/ha.md), enforced at the
+    interpreter boundary: replaying the same journal and encoding the
+    same Assignment under two different PYTHONHASHSEED values — fresh
+    subprocesses, so set/dict iteration order genuinely differs — must
+    land on identical bytes.  This is the runtime twin of tpulint's
+    determinism-taint family."""
+    import subprocess
+    import sys as _sys
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    outs = []
+    for seed in ("0", "1"):
+        env = dict(os.environ, PYTHONHASHSEED=seed, JAX_PLATFORMS="cpu")
+        proc = subprocess.run([_sys.executable, "-c", _HASHSEED_SCRIPT],
+                              env=env, cwd=repo, capture_output=True,
+                              timeout=60)
+        assert proc.returncode == 0, proc.stderr.decode()
+        outs.append(proc.stdout)
+    assert outs[0], "subprocess produced no bytes"
+    assert outs[0] == outs[1]
+
+
 def test_control_state_wave_settles_quorum_ledger():
     """A wave (epoch boundary) drops outstanding corrections and prunes
     old-epoch records — mirroring QuorumTable.epoch_changed."""

@@ -81,6 +81,20 @@ def seeded_line(relpath: str, rule: str) -> int:
     ("lock-order-cycle", "rabit_tpu/tracker/tracker.py"),
     ("lock-across-reactor-wait", "rabit_tpu/tracker/tracker.py"),
     ("thread-shared-mutation", "rabit_tpu/tracker/tracker.py"),
+    # v3 dataflow families (ISSUE 19): resource-lifecycle over the
+    # abstract-interpretation lifecycle states, determinism-taint from
+    # the bitwise-contract roots, serving-path parity across the three
+    # dispatch surfaces plus the exemption-ledger closure.
+    ("resource-leak", "rabit_tpu/relay/__init__.py"),
+    ("resource-exc-leak", "rabit_tpu/relay/__init__.py"),
+    ("resource-self-unreleased", "rabit_tpu/relay/__init__.py"),
+    ("determinism-unsorted-json", "rabit_tpu/ha/state.py"),
+    ("determinism-unordered-iter", "rabit_tpu/ha/state.py"),
+    ("determinism-impure-taint", "rabit_tpu/ha/state.py"),
+    ("parity-cmd-unserved", "rabit_tpu/tracker/protocol.py"),
+    ("parity-exempt-stale", "rabit_tpu/tracker/protocol.py"),
+    ("parity-side-effect-divergence", "rabit_tpu/tracker/tracker.py"),
+    ("parity-route-dead", "rabit_tpu/relay/__init__.py"),
 ])
 def test_fixture_violation_flagged(rule, relpath):
     proc = run_tpulint("--root", str(FIXTURE))
@@ -324,3 +338,200 @@ def test_callgraph_cross_module_resolution(tmp_path):
     })
     for entry in ("pkg/b.py::caller", "pkg/b.py::caller2"):
         assert "pkg/a.py::helper" in g.reachable([entry]), entry
+
+
+# -- dataflow substrate unit tests (v3) ---------------------------------------
+
+def _func(src: str):
+    import ast
+    tree = ast.parse(src)
+    return next(n for n in ast.walk(tree)
+                if isinstance(n, ast.FunctionDef))
+
+
+@pytest.mark.parametrize("name,src,verdict", [
+    ("normal leak", """
+def f(host):
+    s = socket.socket()
+    s.connect((host, 9))
+""", "normal_leak"),
+    ("exception leak past the close", """
+def f(host):
+    s = socket.socket()
+    s.connect((host, 9))
+    s.close()
+""", "exc_leak"),
+    ("with-managed handle is clean", """
+def f(host):
+    s = socket.socket()
+    with s:
+        s.connect((host, 9))
+""", "clean"),
+    ("try/finally covers both exits", """
+def f(host):
+    s = socket.socket()
+    try:
+        s.connect((host, 9))
+    finally:
+        s.close()
+""", "clean"),
+    ("returned handle is the caller's obligation", """
+def f():
+    s = socket.socket()
+    return s
+""", "escaped"),
+    ("handed to another call = ownership transfer", """
+def f(reg):
+    s = socket.socket()
+    reg.adopt(s)
+""", "escaped"),
+    ("branch that skips the close leaks", """
+def f(host, dry):
+    s = socket.socket()
+    if not dry:
+        s.close()
+""", "normal_leak"),
+    ("release on every branch is clean", """
+def f(host, fast):
+    s = socket.socket()
+    if fast:
+        s.close()
+    else:
+        s.shutdown(2)
+""", "clean"),
+    ("reading through the handle does not alias it", """
+def f(host):
+    s = socket.socket()
+    data = s.recv(64)
+    s.close()
+    return data
+""", "exc_leak"),
+])
+def test_lifecycle_verdicts(name, src, verdict):
+    from tools.tpulint import dataflow
+    lcs = dataflow.analyze_lifecycles(_func(src))
+    assert len(lcs) == 1, name
+    lc = lcs[0]
+    if verdict == "normal_leak":
+        assert lc.normal_leak is not None, (name, lc)
+    elif verdict == "exc_leak":
+        assert lc.normal_leak is None and lc.exc_leak is not None \
+            and not lc.escaped, (name, lc)
+    elif verdict == "escaped":
+        assert lc.escaped, (name, lc)
+    else:
+        assert lc.normal_leak is None and lc.exc_leak is None \
+            and not lc.escaped, (name, lc)
+
+
+def test_daemon_threads_are_exempt():
+    from tools.tpulint import dataflow
+    lcs = dataflow.analyze_lifecycles(_func("""
+def f(fn):
+    t = threading.Thread(target=fn, daemon=True)
+    t.start()
+"""))
+    assert lcs == []
+    lcs = dataflow.analyze_lifecycles(_func("""
+def f(fn):
+    t = threading.Thread(target=fn)
+    t.start()
+"""))
+    assert len(lcs) == 1 and lcs[0].normal_leak is not None
+
+
+def test_taint_propagates_through_def_use_chains():
+    from tools.tpulint import dataflow
+
+    def impure(call):
+        return dataflow.call_name(call) == ("time", "time")
+
+    func = _func("""
+def f(xs):
+    t = time.time()
+    budget = t + 5.0
+    n = len(xs)
+    label = f"n={n}"
+    return budget
+""")
+    assert dataflow.tainted_vars(func, impure) == {"t", "budget"}
+
+
+def test_set_typed_vars_tracks_operators_not_sorted():
+    from tools.tpulint import dataflow
+    func = _func("""
+def f(xs, ys):
+    s = set(xs)
+    u = s | set(ys)
+    ordered = sorted(u)
+    return ordered
+""")
+    typed = dataflow.set_typed_vars(func)
+    assert {"s", "u"} <= typed
+    assert "ordered" not in typed
+
+
+# -- v3 CLI surface: --only, per-family JSON counts, timings ------------------
+
+def test_only_runs_a_single_family():
+    proc = run_tpulint("--root", str(FIXTURE), "--only", "determinism")
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    rules = {m.group(1) for m in
+             re.finditer(r"\[([a-z-]+)\]", proc.stdout)}
+    assert rules == {"determinism-unsorted-json",
+                     "determinism-unordered-iter",
+                     "determinism-impure-taint"}
+    # single-family view must not report the other families' baseline
+    # entries as stale, nor combine with the baseline-rewriting modes
+    assert "stale" not in proc.stdout or "0 stale" in proc.stdout
+    proc = run_tpulint("--root", str(FIXTURE), "--only", "determinism",
+                       "--prune")
+    assert proc.returncode == 2
+
+
+def test_json_reports_per_family_counts(tmp_path):
+    out = tmp_path / "findings.json"
+    proc = run_tpulint("--root", str(FIXTURE), "--json", str(out),
+                       "--timings")
+    assert proc.returncode == 1
+    doc = json.loads(out.read_text())
+    fam = doc["families"]
+    for name in ("resources", "determinism", "serving-parity", "locks"):
+        assert name in fam, sorted(fam)
+        assert set(fam[name]) == {"findings", "new", "seconds"}
+    assert fam["determinism"]["new"] == 3
+    # unserved x2 (reactor + relay-fold), stale, diverge, route-dead
+    assert fam["serving-parity"]["new"] == 5
+    assert fam["resources"]["new"] == 3
+    assert sum(f["new"] for f in fam.values()) == doc["counts"]["new"]
+    assert re.search(r"tpulint: timing: determinism\s+\d+\.\d+s",
+                     proc.stdout), proc.stdout
+
+
+# -- serving-path parity: the real tree's coverage table ----------------------
+
+def test_real_tree_parity_coverage_table():
+    """The acceptance claim (ISSUE 19): CMD_OBS and CMD_QUORUM are
+    provably served at all three serving paths, CMD_JOURNAL at the
+    threaded and reactor paths with the relay-fold asymmetry declared
+    in protocol.PARITY_EXEMPT."""
+    from tools.tpulint import servingparity
+    from tools.tpulint.callgraph import CallGraph
+    from tools.tpulint.core import iter_python_files
+
+    files = iter_python_files(REPO, ["rabit_tpu/**/*.py"],
+                              exclude_parts=("data",))
+    graph = CallGraph.build(files, REPO)
+    cov = servingparity.path_coverage(graph)
+    assert set(cov) == {"threaded", "reactor", "relay-fold"}
+    for cmd in ("CMD_OBS", "CMD_QUORUM"):
+        for path in cov:
+            assert cmd in cov[path], (cmd, path, sorted(cov[path]))
+    assert "CMD_JOURNAL" in cov["threaded"]
+    assert "CMD_JOURNAL" in cov["reactor"]
+    assert "CMD_JOURNAL" not in cov["relay-fold"]
+    exempt = servingparity.load_exemptions(
+        REPO / "rabit_tpu" / "tracker" / "protocol.py")
+    assert "CMD_JOURNAL" in exempt["relay-fold"]
+    # and the family as a whole signs off on the real tree
+    assert servingparity.check_parity(graph, REPO) == []

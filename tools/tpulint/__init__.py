@@ -45,6 +45,33 @@ import-aware module calls, bounded-depth reachability):
   state touched from two thread contexts (reactor, relay channels,
   monitor ticks, wave completer) must be mutated under a lock.
 
+The v3 families ride a dataflow substrate layered on the call graph
+(``tools/tpulint/dataflow.py``: per-function def-use chains, taint
+closure, and a path-aware acquire/release lifecycle interpreter with
+escape analysis):
+
+* **resources** (``resource-leak`` / ``resource-exc-leak`` /
+  ``resource-self-unreleased``) — every socket/file/selector/thread
+  acquired in the connection-handling surface reaches its release on
+  all paths, including exception exits; handles stored on ``self``
+  must be torn down by some method of the class, its MRO, or a
+  subclass.  Guards doc/scaling.md's O(relays) fd budget.
+* **determinism** (``determinism-unordered-iter`` /
+  ``determinism-impure-taint`` / ``determinism-unsorted-json``) —
+  from the bitwise-contract roots (``Assignment.encode``, the frame
+  builders, ``ControlState.snapshot_bytes``/``replay``, the
+  compressor transport) nothing nondeterministic — set-order
+  accumulation, time/random/id/hash taint, non-canonical
+  ``json.dumps`` — may reach an encoded artifact (doc/ha.md's byte
+  gate).
+* **serving-parity** (``parity-cmd-unserved`` /
+  ``parity-side-effect-divergence`` / ``parity-exempt-stale`` /
+  ``parity-route-dead``) — the threaded handler, the reactor read
+  callback, and the relay batch fold must answer the same command set
+  with the same journal side-effects; deliberate asymmetries are
+  declared in ``tracker/protocol.py::PARITY_EXEMPT`` and stale
+  entries are themselves findings.
+
 Findings are suppressed only via the baseline file
 (``tools/tpulint/baseline.json``); every suppression carries a one-line
 justification and the tool rejects baselines without one (``--prune``

@@ -1,0 +1,499 @@
+"""Per-function dataflow substrate under the v3 families
+(doc/static_analysis.md): def-use chains, taint propagation helpers,
+and path-aware resource lifecycle analysis through
+``try``/``finally``/``with``.
+
+The lifecycle analyzer is a structural abstract interpreter over one
+function body, tracking ONE acquired handle at a time through the
+states ``virgin -> held -> released | escaped``:
+
+* branches (``if``/``for``/``while``) fork the state set and union the
+  arms back together (a loop body runs zero-or-more times);
+* ``with v:`` (or ``with closing(v):``) both releases the handle at
+  block end and covers exception exits inside the block;
+* a ``try`` whose ``finally`` (or broad handler) releases the handle
+  covers exception exits from its body;
+* a ``return``/``raise`` terminates the path — returning the handle is
+  an ownership transfer (escape), returning WITHOUT it while held is a
+  normal-path leak, raising uncovered while held is an exception leak;
+* storing the handle (``self.attr = v``, ``d[k] = v``, ``lst.append(v)``,
+  passing it as a call argument, capturing it in a closure) escapes it —
+  ownership moved to a container that carries its own teardown
+  obligation (the class-level check in tools/tpulint/resources.py).
+
+Deliberate approximations: one escaping path suppresses leak reports
+for that acquire (conservative); any intervening call is assumed able
+to raise (CPython reality); a re-assignment of the variable releases
+the old handle (avoids double-reporting aliased handles).
+
+Pure stdlib ``ast``; shared by the resources and determinism families.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+# -- lexical walking that respects deferred execution ------------------------
+
+_DEFERRED = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda, ast.ClassDef)
+
+
+def shallow_walk(node: ast.AST):
+    """Every node lexically inside ``node`` excluding nested
+    function/class/lambda bodies (the deferred node itself IS yielded,
+    so callers can inspect closures without executing into them)."""
+    stack: list[ast.AST] = [node]
+    first = True
+    while stack:
+        n = stack.pop()
+        if not first and isinstance(n, _DEFERRED):
+            yield n
+            continue
+        first = False
+        yield n
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def names_in(node: ast.AST) -> set[str]:
+    """All Name identifiers anywhere under ``node`` (full walk —
+    used to detect closure capture inside deferred bodies)."""
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def call_name(call: ast.Call) -> tuple[str, str]:
+    """``(receiver, name)`` of a call: ``("socket", "socket")`` for
+    ``socket.socket(...)``, ``("", "open")`` for ``open(...)``."""
+    fn = call.func
+    if isinstance(fn, ast.Attribute):
+        base = fn.value.id if isinstance(fn.value, ast.Name) else ""
+        return base, fn.attr
+    if isinstance(fn, ast.Name):
+        return "", fn.id
+    return "", ""
+
+
+# -- acquire-site detection ---------------------------------------------------
+
+#: release methods that discharge the teardown obligation, per kind
+RELEASE_METHODS: dict[str, frozenset] = {
+    "socket": frozenset({"close", "detach", "shutdown"}),
+    "file": frozenset({"close"}),
+    "thread": frozenset({"join"}),
+    "selector": frozenset({"close"}),
+}
+
+#: (receiver, callee) -> kind for direct acquiring calls
+_ACQUIRE_CALLS: dict[tuple[str, str], str] = {
+    ("socket", "socket"): "socket",
+    ("socket", "create_connection"): "socket",
+    ("", "create_connection"): "socket",
+    ("", "open"): "file",
+    ("io", "open"): "file",
+    ("gzip", "open"): "file",
+    ("os", "fdopen"): "file",
+    ("threading", "Thread"): "thread",
+    ("", "Thread"): "thread",
+    ("selectors", "DefaultSelector"): "selector",
+    ("", "DefaultSelector"): "selector",
+}
+
+
+@dataclass
+class Acquire:
+    var: str
+    kind: str
+    line: int
+    stmt: ast.stmt           # the acquiring Assign statement
+    daemon: bool = False     # Thread(daemon=True): fire-and-forget by design
+
+
+@dataclass
+class SelfAcquire:
+    """``self.attr = socket.socket(...)`` — the handle is born owned by
+    the instance; the class must release it somewhere."""
+    attr: str
+    kind: str
+    line: int
+    daemon: bool = False
+
+
+def classify_acquire(value: ast.AST) -> tuple[str, bool] | None:
+    """``(kind, daemon)`` when ``value`` is a resource-acquiring call."""
+    if not isinstance(value, ast.Call):
+        return None
+    kind = _ACQUIRE_CALLS.get(call_name(value))
+    if kind is None:
+        return None
+    daemon = False
+    if kind == "thread":
+        for kw in value.keywords:
+            if kw.arg == "daemon" and isinstance(kw.value, ast.Constant) \
+                    and kw.value.value is True:
+                daemon = True
+    return kind, daemon
+
+
+def find_acquires(func: ast.FunctionDef) \
+        -> tuple[list[Acquire], list[SelfAcquire]]:
+    """Acquire sites in one function: local-variable acquires (tracked
+    by the lifecycle analyzer) and direct ``self.attr = acquire()``
+    stores (class-level obligation)."""
+    local: list[Acquire] = []
+    stored: list[SelfAcquire] = []
+    for node in shallow_walk(func):
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        target, value = node.targets[0], node.value
+        got = classify_acquire(value)
+        if got is not None:
+            kind, daemon = got
+            if isinstance(target, ast.Name):
+                local.append(Acquire(target.id, kind, node.lineno, node,
+                                     daemon))
+            elif isinstance(target, ast.Attribute) \
+                    and isinstance(target.value, ast.Name) \
+                    and target.value.id == "self":
+                stored.append(SelfAcquire(target.attr, kind, node.lineno,
+                                          daemon))
+            continue
+        # conn, addr = srv.accept() — the first element is a new socket
+        if isinstance(target, ast.Tuple) and isinstance(value, ast.Call) \
+                and call_name(value)[1] == "accept" and target.elts \
+                and isinstance(target.elts[0], ast.Name) \
+                and isinstance(value.func, ast.Attribute):
+            local.append(Acquire(target.elts[0].id, "socket",
+                                 node.lineno, node))
+    return local, stored
+
+
+# -- path-aware lifecycle analysis --------------------------------------------
+
+VIRGIN, HELD, RELEASED, ESCAPED = "virgin", "held", "released", "escaped"
+
+
+@dataclass
+class Lifecycle:
+    acquire: Acquire
+    normal_leak: int | None = None   # line of a normal exit holding the handle
+    exc_leak: int | None = None      # line of an uncovered raise point
+    escaped: bool = False
+    self_attrs: list[str] = field(default_factory=list)
+
+
+class _Analyzer:
+    def __init__(self, acq: Acquire) -> None:
+        self.acq = acq
+        self.rel = RELEASE_METHODS[acq.kind]
+        self.cover = 0               # inside try/finally (or with v:) scope
+        self.lc = Lifecycle(acq)
+
+    # -- variable queries ----------------------------------------------------
+
+    def _is_var(self, node: ast.AST) -> bool:
+        return isinstance(node, ast.Name) and node.id == self.acq.var
+
+    def _var_in(self, node: ast.AST) -> bool:
+        v = self.acq.var
+        for n in shallow_walk(node):
+            if isinstance(n, ast.Name) and n.id == v:
+                return True
+            if isinstance(n, _DEFERRED) and v in names_in(n):
+                return True   # closure capture
+        return False
+
+    def _var_aliased_in(self, node: ast.AST) -> bool:
+        """Like ``_var_in`` but a method-call receiver does not count:
+        ``data = v.recv(n)`` reads THROUGH the handle, it does not
+        alias it."""
+        v = self.acq.var
+        receivers = {id(n.func.value) for n in shallow_walk(node)
+                     if isinstance(n, ast.Call)
+                     and isinstance(n.func, ast.Attribute)}
+        for n in shallow_walk(node):
+            if isinstance(n, ast.Name) and n.id == v \
+                    and id(n) not in receivers:
+                return True
+            if isinstance(n, _DEFERRED) and v in names_in(n):
+                return True   # closure capture
+        return False
+
+    def _release_calls(self, node: ast.AST) -> list[ast.Call]:
+        out = []
+        for n in shallow_walk(node):
+            if isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute) \
+                    and self._is_var(n.func.value) and n.func.attr in self.rel:
+                out.append(n)
+        return out
+
+    def _releases_in(self, stmts: list[ast.stmt]) -> bool:
+        return any(self._release_calls(s) for s in stmts)
+
+    def _escapes_in(self, node: ast.AST) -> bool:
+        """The handle is stored, passed, aliased, yielded or captured —
+        ownership leaves this variable."""
+        v = self.acq.var
+        for n in shallow_walk(node):
+            if isinstance(n, _DEFERRED) and v in names_in(n):
+                return True
+            if isinstance(n, ast.Call):
+                for a in list(n.args) + [kw.value for kw in n.keywords]:
+                    if any(self._is_var(x) for x in shallow_walk(a)):
+                        # self._threads.append(v): the handle moved into
+                        # an instance container — class-level obligation
+                        fn = n.func
+                        if isinstance(fn, ast.Attribute) \
+                                and fn.attr in ("append", "add", "insert",
+                                                "setdefault") \
+                                and isinstance(fn.value, ast.Attribute) \
+                                and isinstance(fn.value.value, ast.Name) \
+                                and fn.value.value.id == "self":
+                            self.lc.self_attrs.append(fn.value.attr)
+                        return True
+            elif isinstance(n, (ast.Yield, ast.YieldFrom)) and n.value \
+                    and self._var_in(n.value):
+                return True
+            elif isinstance(n, ast.Assign) and n is not self.acq.stmt \
+                    and self._var_aliased_in(n.value):
+                for t in n.targets:
+                    if isinstance(t, ast.Attribute) \
+                            and isinstance(t.value, ast.Name) \
+                            and t.value.id == "self":
+                        self.lc.self_attrs.append(t.attr)
+                    elif isinstance(t, ast.Subscript) \
+                            and isinstance(t.value, ast.Attribute) \
+                            and isinstance(t.value.value, ast.Name) \
+                            and t.value.value.id == "self":
+                        # self._conns[tid] = v: instance container store
+                        self.lc.self_attrs.append(t.value.attr)
+                return True
+        return False
+
+    def _can_raise(self, node: ast.AST) -> bool:
+        """Any intervening call can raise — except the acquire itself
+        and release calls on the handle (closing is the safe part)."""
+        rel = set(map(id, self._release_calls(node)))
+        for n in shallow_walk(node):
+            if isinstance(n, ast.Call) and id(n) not in rel \
+                    and n is not getattr(self.acq.stmt, "value", None):
+                return True
+        return False
+
+    # -- interpreter ---------------------------------------------------------
+
+    def exec_block(self, stmts: list[ast.stmt], states: set[str]) -> set[str]:
+        for stmt in stmts:
+            if not states:
+                break
+            states = self.exec_stmt(stmt, states)
+        return states
+
+    def _apply_events(self, node: ast.AST, states: set[str]) -> set[str]:
+        """Release/escape/raise effects of one non-control statement (or
+        of a control statement's head expression)."""
+        if HELD in states and self.cover == 0 and self.lc.exc_leak is None \
+                and self._can_raise(node):
+            self.lc.exc_leak = getattr(node, "lineno", self.acq.line)
+        released = bool(self._release_calls(node))
+        escaped = self._escapes_in(node)
+        # v.daemon = True after the fact: fire-and-forget by design
+        if isinstance(node, ast.Assign) \
+                and any(isinstance(t, ast.Attribute) and t.attr == "daemon"
+                        and self._is_var(t.value) for t in node.targets) \
+                and isinstance(node.value, ast.Constant) \
+                and node.value.value is True:
+            released = True
+        if escaped:
+            self.lc.escaped = True
+            states = {ESCAPED if s == HELD else s for s in states}
+        if released:
+            states = {RELEASED if s == HELD else s for s in states}
+        # re-assignment of the variable drops the old handle
+        if isinstance(node, ast.Assign) and node is not self.acq.stmt \
+                and any(self._is_var(t) for t in node.targets):
+            states = {RELEASED if s == HELD else s for s in states}
+        return states
+
+    def exec_stmt(self, stmt: ast.stmt, states: set[str]) -> set[str]:
+        if stmt is self.acq.stmt:
+            return {HELD if s == VIRGIN else s for s in states}
+
+        if isinstance(stmt, ast.If):
+            states = self._apply_events(stmt.test, states)
+            return (self.exec_block(stmt.body, set(states))
+                    | self.exec_block(stmt.orelse, set(states)))
+
+        if isinstance(stmt, (ast.For, ast.While)):
+            head = stmt.iter if isinstance(stmt, ast.For) else stmt.test
+            states = self._apply_events(head, states)
+            once = self.exec_block(stmt.body, set(states))
+            out = states | once
+            if stmt.orelse:
+                out |= self.exec_block(stmt.orelse, set(out))
+            return out
+
+        if isinstance(stmt, ast.With):
+            managed = any(
+                self._is_var(item.context_expr)
+                or (isinstance(item.context_expr, ast.Call)
+                    and any(self._is_var(a)
+                            for a in item.context_expr.args))
+                for item in stmt.items)
+            if managed:
+                self.cover += 1
+                inner = self.exec_block(stmt.body, set(states))
+                self.cover -= 1
+                return {RELEASED if s == HELD else s for s in inner}
+            for item in stmt.items:
+                states = self._apply_events(item.context_expr, states)
+            return self.exec_block(stmt.body, states)
+
+        if isinstance(stmt, ast.Try):
+            covered = (self._releases_in(stmt.finalbody)
+                       or any(self._releases_in(h.body)
+                              for h in stmt.handlers))
+            if covered:
+                self.cover += 1
+            body_states = self.exec_block(stmt.body, set(states))
+            if covered:
+                self.cover -= 1
+            handler_entry = states | body_states
+            out: set[str] = set()
+            for h in stmt.handlers:
+                out |= self.exec_block(h.body, set(handler_entry))
+            out |= (self.exec_block(stmt.orelse, set(body_states))
+                    if stmt.orelse else body_states)
+            if stmt.finalbody:
+                out = self.exec_block(stmt.finalbody, out)
+            return out
+
+        if isinstance(stmt, ast.Return):
+            if stmt.value is not None:
+                if self._var_in(stmt.value):
+                    self.lc.escaped = True
+                    return set()
+                states = self._apply_events(stmt.value, states)
+            if HELD in states and self.lc.normal_leak is None:
+                self.lc.normal_leak = stmt.lineno
+            return set()
+
+        if isinstance(stmt, ast.Raise):
+            if HELD in states and self.cover == 0 \
+                    and self.lc.exc_leak is None:
+                self.lc.exc_leak = stmt.lineno
+            return set()
+
+        if isinstance(stmt, (ast.Break, ast.Continue)):
+            return states
+
+        return self._apply_events(stmt, states)
+
+
+def analyze_lifecycles(func: ast.FunctionDef) -> list[Lifecycle]:
+    """Lifecycle verdicts for every tracked local acquire in ``func``."""
+    local, _stored = find_acquires(func)
+    out: list[Lifecycle] = []
+    for acq in local:
+        if acq.daemon:
+            continue
+        a = _Analyzer(acq)
+        end = a.exec_block(func.body, {VIRGIN})
+        if HELD in end and a.lc.normal_leak is None:
+            a.lc.normal_leak = getattr(func.body[-1], "end_lineno",
+                                       acq.line) or acq.line
+        out.append(a.lc)
+    return out
+
+
+# -- def-use chains and taint propagation -------------------------------------
+
+def def_use(func: ast.FunctionDef) -> dict[str, list[ast.expr]]:
+    """Variable -> list of RHS expressions assigned to it (shallow:
+    nested def/lambda bodies excluded).  ``for x in E`` counts E,
+    ``with E as x`` counts E, ``x op= E`` counts E."""
+    out: dict[str, list[ast.expr]] = {}
+
+    def bind(target: ast.AST, value: ast.expr | None) -> None:
+        if value is None:
+            return
+        if isinstance(target, ast.Name):
+            out.setdefault(target.id, []).append(value)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for e in target.elts:
+                bind(e, value)
+
+    for node in shallow_walk(func):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                bind(t, node.value)
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            bind(node.target, node.value)
+        elif isinstance(node, ast.For):
+            bind(node.target, node.iter)
+        elif isinstance(node, ast.With):
+            for item in node.items:
+                if item.optional_vars is not None:
+                    bind(item.optional_vars, item.context_expr)
+        elif isinstance(node, (ast.NamedExpr,)):
+            bind(node.target, node.value)
+    return out
+
+
+def tainted_vars(func: ast.FunctionDef, is_source) -> set[str]:
+    """Fixpoint over the def-use chains: variables whose value derives
+    from a call for which ``is_source(call)`` is true (directly or
+    through other tainted variables)."""
+    chains = def_use(func)
+    tainted: set[str] = set()
+    changed = True
+    while changed:
+        changed = False
+        for var, rhss in chains.items():
+            if var in tainted:
+                continue
+            for rhs in rhss:
+                hit = False
+                for n in shallow_walk(rhs):
+                    if isinstance(n, ast.Call) and is_source(n):
+                        hit = True
+                    elif isinstance(n, ast.Name) and n.id in tainted:
+                        hit = True
+                    if hit:
+                        break
+                if hit:
+                    tainted.add(var)
+                    changed = True
+                    break
+    return tainted
+
+
+def set_typed_vars(func: ast.FunctionDef) -> set[str]:
+    """Variables that (on some path) hold a ``set`` — assigned from a
+    set literal/comprehension, a ``set()``/``frozenset()`` call, or a
+    set-operator expression over another set-typed variable."""
+    chains = def_use(func)
+    known: set[str] = set()
+
+    def is_set_expr(e: ast.expr) -> bool:
+        if isinstance(e, (ast.Set, ast.SetComp)):
+            return True
+        if isinstance(e, ast.Call) \
+                and call_name(e)[1] in ("set", "frozenset"):
+            return True
+        if isinstance(e, ast.Name):
+            return e.id in known
+        if isinstance(e, ast.BinOp) and isinstance(
+                e.op, (ast.BitOr, ast.BitAnd, ast.Sub, ast.BitXor)):
+            return is_set_expr(e.left) or is_set_expr(e.right)
+        return False
+
+    changed = True
+    while changed:
+        changed = False
+        for var, rhss in chains.items():
+            if var in known:
+                continue
+            if any(is_set_expr(r) for r in rhss):
+                known.add(var)
+                changed = True
+    return known

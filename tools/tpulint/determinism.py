@@ -1,0 +1,291 @@
+"""``determinism-unordered-iter`` / ``determinism-impure-taint`` /
+``determinism-unsorted-json`` — no reachable nondeterminism on the
+bitwise-contract paths.
+
+The contract (doc/ha.md, doc/partial_allreduce.md): every recovery
+path must reproduce the fold bitwise — same blocks, same order, same
+bits on every rank — and the HA journal replay plus
+``ControlState.snapshot_bytes`` must agree byte-for-byte between the
+primary and every standby.  The fuzz campaigns enforce this
+dynamically; this family enforces it statically, from the contract
+ROOTS outward along the shared call graph:
+
+* rank-order folds — ``compress/transport.py`` (``host_allreduce``,
+  ``_fold``), ``elastic/client.py`` (``_allreduce_sum``, the quorum
+  fold, block encode/decode), ``engine/fused.py`` (``_fold_fn``,
+  ``build_fused_allreduce``);
+* wire encodes — ``tracker/protocol.py`` ``put_*`` frames,
+  ``Assignment.encode`` head/tail, ``send_hello``;
+* HA replay — ``ControlState.apply``/``snapshot``/``snapshot_bytes``,
+  ``ha/journal.py`` ``replay``.
+
+Three rules, all dataflow-gated to kill observational-only noise
+(``host_allreduce`` metering its wall time must NOT flag):
+
+* ``determinism-unordered-iter`` — a loop or list/generator
+  comprehension iterating a ``set``-typed value (hash-seed order)
+  whose body feeds an order-sensitive accumulation (``append``,
+  ``extend``, ``+=``, a ``write``/``send``/``put_*`` call, a ``join``);
+  set-to-set rebuilds and order-insensitive drains (``pop``,
+  ``discard``) stay silent — wrap the iterable in ``sorted()``;
+* ``determinism-impure-taint`` — ``time.*``/``random.*``/``id()``/
+  ``hash()``/``uuid.*``/``os.urandom`` whose RESULT (via the
+  per-function def-use chains) reaches a return value or an encode
+  sink (``put_*``, ``.pack``, ``json.dumps``, ``.encode``, a send);
+  deadline checks and metering that never touch the produced bytes
+  are not findings;
+* ``determinism-unsorted-json`` — ``json.dumps`` without
+  ``sort_keys=True`` on a contract path, and unsorted
+  ``os.listdir``/``glob.glob``/``iterdir`` (directory order is
+  filesystem-dependent) anywhere root-reachable.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+
+from tools.tpulint import dataflow
+from tools.tpulint.callgraph import CallGraph
+from tools.tpulint.core import Finding
+
+RULE_ITER = "determinism-unordered-iter"
+RULE_TAINT = "determinism-impure-taint"
+RULE_JSON = "determinism-unsorted-json"
+
+#: bitwise-contract roots by module suffix -> function/method names
+ROOTS: dict[str, frozenset] = {
+    "compress/transport.py": frozenset({
+        "host_allreduce", "reference_allreduce", "encode_wire", "_fold"}),
+    "elastic/client.py": frozenset({
+        "_allreduce_sum", "_quorum_allreduce", "_encode_block",
+        "_decode_block", "_sync_state"}),
+    "engine/fused.py": frozenset({"_fold_fn", "build_fused_allreduce"}),
+    "ha/state.py": frozenset({"apply", "snapshot", "snapshot_bytes"}),
+    "ha/journal.py": frozenset({"replay"}),
+}
+
+#: protocol.py wire-encode roots are name-shaped: every put_* frame
+#: encoder plus the Assignment encode path.
+_PROTOCOL_SUFFIX = "tracker/protocol.py"
+_PROTOCOL_NAMES = frozenset({"encode", "send_hello", "assignment_head_bytes",
+                             "assignment_tail_bytes"})
+
+#: contract reach stays shallow: the longest real chain we guard
+#: (quorum fold -> refold -> codec encode) is depth 4.
+MAX_DEPTH = 6
+
+_IMPURE_MODULES = frozenset({"time", "random", "uuid", "secrets"})
+_IMPURE_BARE = frozenset({"id", "hash"})
+
+_SINK_ATTRS = frozenset({"pack", "dumps", "encode", "sendall", "send",
+                         "write", "tobytes", "digest", "hexdigest"})
+
+_FS_CALLS = frozenset({("os", "listdir"), ("glob", "glob"),
+                       ("glob", "iglob"), ("", "listdir"),
+                       ("", "scandir"), ("os", "scandir")})
+
+#: order-sensitive accumulation inside an iteration body
+_ACCUM_ATTRS = frozenset({"append", "extend", "write", "sendall", "send",
+                          "put", "join", "update"})
+
+
+def entry_quals(graph: CallGraph) -> list[str]:
+    out = []
+    for qual, fi in graph.funcs.items():
+        for suffix, names in ROOTS.items():
+            if fi.module.endswith(suffix) and fi.name in names:
+                out.append(qual)
+        if fi.module.endswith(_PROTOCOL_SUFFIX) and (
+                fi.name.startswith("put_") or fi.name in _PROTOCOL_NAMES):
+            out.append(qual)
+    return sorted(set(out))
+
+
+def _is_impure(call: ast.Call) -> bool:
+    base, name = dataflow.call_name(call)
+    if base in _IMPURE_MODULES:
+        return True
+    if base == "os" and name == "urandom":
+        return True
+    return base == "" and name in _IMPURE_BARE
+
+
+def _impure_label(call: ast.Call) -> str:
+    base, name = dataflow.call_name(call)
+    return f"{base}.{name}" if base else f"{name}()"
+
+
+def _contains_tainted(node: ast.AST, tainted: set[str]) -> ast.AST | None:
+    """First impure call or tainted Name lexically under ``node``."""
+    for n in dataflow.shallow_walk(node):
+        if isinstance(n, ast.Call) and _is_impure(n):
+            return n
+        if isinstance(n, ast.Name) and n.id in tainted:
+            return n
+    return None
+
+
+def _taint_findings(fi, chain: str) -> list[Finding]:
+    func = fi.node
+    tainted = dataflow.tainted_vars(func, _is_impure)
+    short = f"{fi.cls}.{fi.name}" if fi.cls else fi.name
+    out: list[Finding] = []
+    seen: set[str] = set()
+
+    def flag(evidence: ast.AST, where: str, line: int) -> None:
+        label = (_impure_label(evidence) if isinstance(evidence, ast.Call)
+                 else evidence.id)
+        token = f"{short}:{label}"
+        if token in seen:
+            return
+        seen.add(token)
+        out.append(Finding(
+            rule=RULE_TAINT, path=fi.module, line=line,
+            message=(f"nondeterministic value from {label} reaches "
+                     f"{where} in {short} (contract path: {chain}) — "
+                     f"the bitwise replay/fold contract forbids "
+                     f"wall-clock, hash-seed or id() bits here"),
+            token=token))
+
+    for n in dataflow.shallow_walk(func):
+        if isinstance(n, ast.Return) and n.value is not None:
+            hit = _contains_tainted(n.value, tainted)
+            if hit is not None:
+                flag(hit, "the return value", n.lineno)
+        elif isinstance(n, ast.Call):
+            base, name = dataflow.call_name(n)
+            is_sink = (name in _SINK_ATTRS or name.startswith("put_")
+                       or name.startswith("send_"))
+            if not is_sink:
+                continue
+            for a in list(n.args) + [kw.value for kw in n.keywords]:
+                hit = _contains_tainted(a, tainted)
+                if hit is not None:
+                    flag(hit, f"encode sink {name}()", n.lineno)
+    return out
+
+
+def _order_sensitive_body(nodes: list[ast.AST]) -> bool:
+    for stmt in nodes:
+        for n in dataflow.shallow_walk(stmt):
+            if isinstance(n, ast.AugAssign):
+                return True
+            if isinstance(n, ast.Call) and isinstance(
+                    n.func, ast.Attribute) and n.func.attr in _ACCUM_ATTRS:
+                return True
+            if isinstance(n, (ast.Yield, ast.YieldFrom)):
+                return True
+    return False
+
+
+def _iter_findings(fi, chain: str) -> list[Finding]:
+    func = fi.node
+    setvars = dataflow.set_typed_vars(func)
+    short = f"{fi.cls}.{fi.name}" if fi.cls else fi.name
+    out: list[Finding] = []
+    seen: set[str] = set()
+
+    def is_set_expr(e: ast.expr) -> bool:
+        if isinstance(e, (ast.Set, ast.SetComp)):
+            return True
+        if isinstance(e, ast.Call) \
+                and dataflow.call_name(e)[1] in ("set", "frozenset"):
+            return True
+        if isinstance(e, ast.Name):
+            return e.id in setvars
+        if isinstance(e, ast.BinOp) and isinstance(
+                e.op, (ast.BitOr, ast.BitAnd, ast.Sub, ast.BitXor)):
+            return is_set_expr(e.left) or is_set_expr(e.right)
+        return False
+
+    def label(e: ast.expr) -> str:
+        return e.id if isinstance(e, ast.Name) else "set-expr"
+
+    def flag(e: ast.expr, line: int, what: str) -> None:
+        token = f"{short}:set-iter:{label(e)}"
+        if token in seen:
+            return
+        seen.add(token)
+        out.append(Finding(
+            rule=RULE_ITER, path=fi.module, line=line,
+            message=(f"{what} iterates set-typed {label(e)!r} in "
+                     f"{short} feeding an order-sensitive accumulation "
+                     f"(contract path: {chain}) — set order is "
+                     f"hash-seed-dependent; wrap it in sorted()"),
+            token=token))
+
+    for n in dataflow.shallow_walk(func):
+        if isinstance(n, ast.For) and is_set_expr(n.iter) \
+                and _order_sensitive_body(n.body):
+            flag(n.iter, n.lineno, "loop")
+        elif isinstance(n, (ast.ListComp, ast.GeneratorExp)):
+            gen = n.generators[0] if n.generators else None
+            if gen is not None and is_set_expr(gen.iter):
+                flag(gen.iter, n.lineno, "comprehension")
+    return out
+
+
+def _json_findings(fi, chain: str, wrapped: set[int]) -> list[Finding]:
+    short = f"{fi.cls}.{fi.name}" if fi.cls else fi.name
+    out: list[Finding] = []
+    for n in dataflow.shallow_walk(fi.node):
+        if not isinstance(n, ast.Call):
+            continue
+        base, name = dataflow.call_name(n)
+        if name == "dumps" and base in ("json", "_json"):
+            sorted_keys = any(
+                kw.arg == "sort_keys" and isinstance(kw.value, ast.Constant)
+                and kw.value.value is True for kw in n.keywords)
+            if not sorted_keys:
+                out.append(Finding(
+                    rule=RULE_JSON, path=fi.module, line=n.lineno,
+                    message=(f"json.dumps without sort_keys=True in "
+                             f"{short} (contract path: {chain}) — "
+                             f"contract-path JSON must be canonical "
+                             f"(sort_keys=True, fixed separators)"),
+                    token=f"{short}:json.dumps"))
+        elif ((base, name) in _FS_CALLS or name == "iterdir") \
+                and id(n) not in wrapped:
+            out.append(Finding(
+                rule=RULE_JSON, path=fi.module, line=n.lineno,
+                message=(f"unsorted {base + '.' if base else ''}{name}() "
+                         f"in {short} (contract path: {chain}) — "
+                         f"directory order is filesystem-dependent; "
+                         f"wrap it in sorted()"),
+                token=f"{short}:{name}"))
+    return out
+
+
+def _sorted_wrapped(tree: ast.AST) -> set[int]:
+    """ids of calls that appear directly inside a sorted(...) argument —
+    sorted(os.listdir(d)) is the fix, not a finding."""
+    out: set[int] = set()
+    for n in ast.walk(tree):
+        if isinstance(n, ast.Call) \
+                and dataflow.call_name(n)[1] == "sorted":
+            for a in n.args:
+                for c in ast.walk(a):
+                    if isinstance(c, ast.Call):
+                        out.add(id(c))
+    return out
+
+
+def check_determinism(graph: CallGraph, root: Path) -> list[Finding]:
+    entries = entry_quals(graph)
+    reach = graph.reachable(entries, max_depth=MAX_DEPTH)
+    findings: list[Finding] = []
+    wrapped_cache: dict[str, set[int]] = {}
+    for qual in sorted(reach, key=lambda q: (reach[q][0], q)):
+        fi = graph.funcs.get(qual)
+        if fi is None:
+            continue
+        chain = " -> ".join(graph.chain(reach, qual))
+        if fi.module not in wrapped_cache:
+            wrapped_cache[fi.module] = (
+                _sorted_wrapped(graph.trees[fi.module])
+                if fi.module in graph.trees else set())
+        findings += _taint_findings(fi, chain)
+        findings += _iter_findings(fi, chain)
+        findings += _json_findings(fi, chain, wrapped_cache[fi.module])
+    return findings

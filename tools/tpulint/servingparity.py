@@ -1,0 +1,453 @@
+"""``parity-cmd-unserved`` / ``parity-exempt-stale`` /
+``parity-side-effect-divergence`` / ``parity-route-dead`` — the three
+serving paths answer the same command set with the same journal
+side-effects.
+
+The drift surface: PRs 12/16/17 each hand-wired the same RPC at three
+places — the threaded per-connection handler (``Tracker._handle``), the
+shared-reactor read callback (``Tracker._reactor_read``) and the relay
+batch fold (``Tracker._fold_batch_msg``) — plus the service/standby
+routing arms.  Nothing checked the closure: a command added to one path
+works in the topology the author tested and silently vanishes in the
+others.  This family turns the three-way wiring into a machine-checked
+registry, like KINDS and the journal-kind catalogue.
+
+Extraction: from each path root, walk the shared call graph (bounded
+depth, serving modules only — protocol.py PARSES commands, it does not
+serve them) and collect every ``cmd == CMD_X`` / ``cmd in (CMD_X, ...)``
+equality arm.  Shared helpers (``_short_rpc_reply``,
+``_route_hello`` and its service override) are reached from every
+path, so parity-by-construction is free and only path-local arms can
+diverge.
+
+Asymmetries that are DESIGN, not drift, are declared in
+``PARITY_EXEMPT`` next to the wire constants in
+``rabit_tpu/tracker/protocol.py`` — path name -> {CMD name: one-line
+reason} — and the family checks the declaration both ways
+(``parity-exempt-stale``: the exemption outlived the asymmetry).
+
+Side-effects: for every (path, command) the journal kinds reachable
+from that command's arms (lambda bodies included — the threaded
+CMD_SHUTDOWN post rides a lambda) must agree across the paths serving
+the command; a divergent set means one path records a mutation another
+path drops (``parity-side-effect-divergence``).
+
+Routing surfaces (``CollectiveService._route_hello`` arms, the relay's
+``_dispatch_child``) are refinements, not full paths: every command
+they special-case must be served by some path
+(``parity-route-dead``), but they owe no full coverage.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from pathlib import Path
+
+from tools.tpulint import dataflow, wire
+from tools.tpulint.callgraph import CallGraph
+from tools.tpulint.core import Finding, const_str
+
+RULE_UNSERVED = "parity-cmd-unserved"
+RULE_STALE = "parity-exempt-stale"
+RULE_DIVERGE = "parity-side-effect-divergence"
+RULE_ROUTE = "parity-route-dead"
+
+#: serving-path roots: (path name, module suffix, method name)
+PATHS: tuple[tuple[str, str, str], ...] = (
+    ("threaded", "tracker/tracker.py", "_handle"),
+    ("reactor", "tracker/tracker.py", "_reactor_read"),
+    ("relay-fold", "tracker/tracker.py", "_fold_batch_msg"),
+)
+
+#: routing refinement surfaces (subset semantics)
+ROUTES: tuple[tuple[str, str, str], ...] = (
+    ("service-route", "service/service.py", "_route_hello"),
+    ("relay-child", "relay/__init__.py", "_dispatch_child"),
+)
+
+#: arms are collected only in modules that SERVE commands; protocol.py
+#: parses every command on every path and would trivialize coverage.
+SERVING_SUFFIXES = ("tracker/tracker.py", "service/service.py")
+
+#: how far a path's dispatch surface extends from its root.  Depth 3
+#: reaches root -> _short_rpc_reply and root -> _route_hello -> the
+#: service override; deeper would pull wave planning's ``p.cmd``
+#: compares in at uneven depths per path.
+ARM_DEPTH = 3
+
+#: how far a command arm's journal side-effects are chased.
+EFFECT_DEPTH = 3
+
+#: routing functions select a tracker, they do not serve the command —
+#: their arms are checked by ``parity-route-dead`` and their admission
+#: side-effects (job_admit on first hello) belong to routing, so they
+#: are excluded from both coverage reach and effect chasing.  Without
+#: this the fold path (which routes BEFORE dispatching on cmd) reads as
+#: journalling less than the paths that route inside the arm.
+ROUTE_NAMES = frozenset({"_route_hello", "_dispatch_child"})
+
+
+@dataclass
+class Arm:
+    """One ``cmd == CMD_X`` (or ``in``-tuple) equality arm."""
+    cmd: str
+    module: str
+    line: int
+    func_qual: str
+    body: list = field(default_factory=list)   # enclosing If body (stmts)
+
+
+def _cmd_refs(node: ast.AST) -> list[str]:
+    out = []
+    for n in ast.walk(node):
+        name = None
+        if isinstance(n, ast.Attribute):
+            name = n.attr
+        elif isinstance(n, ast.Name):
+            name = n.id
+        if name and name.startswith("CMD_"):
+            out.append(name)
+    return out
+
+
+def _equality_cmds(test: ast.expr) -> list[str]:
+    """CMD_* names this If-test positively selects (Eq / In only —
+    ``cmd != CMD_HANGUP`` guards, it does not serve)."""
+    out: list[str] = []
+    for n in ast.walk(test):
+        if not isinstance(n, ast.Compare):
+            continue
+        for op, comp in zip(n.ops, n.comparators):
+            if isinstance(op, ast.Eq):
+                out += _cmd_refs(n.left) + _cmd_refs(comp)
+            elif isinstance(op, ast.In):
+                out += _cmd_refs(comp)
+    return out
+
+
+def collect_arms(func_node: ast.FunctionDef, module: str,
+                 qual: str) -> list[Arm]:
+    """Command arms in one function: If-tests whose equality compares
+    name a CMD_* constant, each with its body for side-effect chasing.
+    Non-If equality uses (assignments, ternaries) count as handled
+    with an empty body."""
+    arms: list[Arm] = []
+
+    def walk(stmts: list[ast.stmt]) -> None:
+        for stmt in stmts:
+            if isinstance(stmt, ast.If):
+                for cmd in dict.fromkeys(_equality_cmds(stmt.test)):
+                    arms.append(Arm(cmd, module, stmt.lineno, qual,
+                                    stmt.body))
+                walk(stmt.body)
+                walk(stmt.orelse)
+            elif isinstance(stmt, ast.Try):
+                walk(stmt.body)
+                for h in stmt.handlers:
+                    walk(h.body)
+                walk(stmt.orelse)
+                walk(stmt.finalbody)
+            elif isinstance(stmt, (ast.For, ast.While)):
+                walk(stmt.body)
+                walk(stmt.orelse)
+            elif isinstance(stmt, ast.With):
+                walk(stmt.body)
+            else:
+                for cmd in dict.fromkeys(_equality_cmds(stmt)):
+                    arms.append(Arm(cmd, module, stmt.lineno, qual, []))
+
+    walk(func_node.body)
+    return arms
+
+
+def _arm_calls(body: list) -> list[ast.Call]:
+    """Every call lexically inside an arm body, INCLUDING lambda bodies
+    (the threaded CMD_SHUTDOWN post is ``lambda: self._note_shutdown``)
+    but excluding nested def/class bodies."""
+    out: list[ast.Call] = []
+    stack: list[ast.AST] = list(body)
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.ClassDef)):
+            continue
+        if isinstance(n, ast.Call):
+            out.append(n)
+        stack.extend(ast.iter_child_nodes(n))
+    return out
+
+
+def _direct_kinds(body: list) -> set[str]:
+    """Constant journal kinds appended directly in an arm body."""
+    kinds: set[str] = set()
+    for call in _arm_calls(body):
+        fn = call.func
+        name = (fn.attr if isinstance(fn, ast.Attribute)
+                else fn.id if isinstance(fn, ast.Name) else "")
+        if name in ("_journal", "put_journal_frame") and call.args:
+            s = const_str(call.args[0])
+            if s is not None:
+                kinds.add(s)
+    return kinds
+
+
+def _name_index(graph: CallGraph) -> dict[str, list[str]]:
+    """bare function name -> quals, serving modules only (resolves
+    ``Thread(target=self._serve_relay)``-shaped spawns by name)."""
+    idx: dict[str, list[str]] = {}
+    for qual, fi in graph.funcs.items():
+        if any(fi.module.endswith(s) for s in SERVING_SUFFIXES):
+            idx.setdefault(fi.name, []).append(qual)
+    return idx
+
+
+def _thread_target_quals(node: ast.AST,
+                         idx: dict[str, list[str]]) -> list[str]:
+    """Functions handed to ``Thread(target=...)`` under ``node``."""
+    out: list[str] = []
+    for n in ast.walk(node):
+        if not (isinstance(n, ast.Call)
+                and dataflow.call_name(n)[1] == "Thread"):
+            continue
+        for kw in n.keywords:
+            if kw.arg != "target":
+                continue
+            v = kw.value
+            tname = (v.attr if isinstance(v, ast.Attribute)
+                     else v.id if isinstance(v, ast.Name) else None)
+            if tname:
+                out += idx.get(tname, [])
+    return out
+
+
+def _serving_reach(graph: CallGraph, roots: list[str], max_depth: int,
+                   idx: dict[str, list[str]]) -> dict[str, int]:
+    """qual -> depth over call edges PLUS zero-cost Thread-target
+    edges — ``_send_wave_async`` spawning ``_send_wave`` and the
+    reactor spawning ``_serve_relay`` are dispatch adapters, not extra
+    hops; without the pseudo-edge the async paths read as serving (and
+    journalling) less than the threaded path.  Routing functions are
+    not expanded (see ROUTE_NAMES)."""
+    depth: dict[str, int] = {}
+    work: list[tuple[str, int]] = [(q, 0) for q in roots]
+    while work:
+        qual, d = work.pop()
+        if qual in depth and depth[qual] <= d:
+            continue
+        fi = graph.funcs.get(qual)
+        if fi is None or fi.name in ROUTE_NAMES:
+            continue
+        depth[qual] = d
+        if d < max_depth:
+            for tgt, _call in graph.edges(qual):
+                work.append((tgt, d + 1))
+        for tq in _thread_target_quals(fi.node, idx):
+            work.append((tq, d))
+    return depth
+
+
+def _arm_effect_kinds(graph: CallGraph, arm: Arm,
+                      idx: dict[str, list[str]]) -> set[str]:
+    """Journal kinds reachable from one command arm: direct appends in
+    the body plus appends in every function the arm's calls (and
+    thread spawns) resolve to, bounded BFS with the same pseudo-edge
+    and routing rules as coverage."""
+    kinds = _direct_kinds(arm.body)
+    fi = graph.funcs.get(arm.func_qual)
+    if fi is None:
+        return kinds
+    targets: list[str] = []
+    for call in _arm_calls(arm.body):
+        for tgt in graph.resolve_call(call, fi):
+            targets.append(tgt.qual)
+    for stmt in arm.body:
+        targets += _thread_target_quals(stmt, idx)
+    for qual in _serving_reach(graph, targets, EFFECT_DEPTH, idx):
+        tfi = graph.funcs.get(qual)
+        if tfi is None:
+            continue
+        kinds |= _direct_kinds(tfi.node.body)
+    return kinds
+
+
+def load_exemptions(protocol_py: Path) -> dict[str, dict[str, tuple]]:
+    """``PARITY_EXEMPT`` from protocol.py: path -> {CMD: (reason, line)}.
+    Missing declaration = no exemptions (every asymmetry is drift)."""
+    from tools.tpulint.core import parse_python
+
+    tree = parse_python(protocol_py)
+    out: dict[str, dict[str, tuple]] = {}
+    if tree is None:
+        return out
+    for node in tree.body:
+        if not isinstance(node, ast.Assign):
+            continue
+        if not any(isinstance(t, ast.Name) and t.id == "PARITY_EXEMPT"
+                   for t in node.targets):
+            continue
+        if not isinstance(node.value, ast.Dict):
+            continue
+        for pk, pv in zip(node.value.keys, node.value.values):
+            path_name = const_str(pk) if pk is not None else None
+            if path_name is None or not isinstance(pv, ast.Dict):
+                continue
+            entry = out.setdefault(path_name, {})
+            for ck, cv in zip(pv.keys, pv.values):
+                cmd = const_str(ck) if ck is not None else None
+                reason = const_str(cv)
+                if cmd is not None and reason is not None:
+                    entry[cmd] = (reason, ck.lineno)
+    return out
+
+
+def _roots(graph: CallGraph, suffix: str, name: str) -> list[str]:
+    return sorted(q for q, fi in graph.funcs.items()
+                  if fi.module.endswith(suffix) and fi.name == name)
+
+
+def path_coverage(graph: CallGraph) -> dict[str, dict[str, list[Arm]]]:
+    """path name -> {CMD name -> arms} for every path with a live root.
+    This is the machine-checked coverage table the acceptance test
+    asserts CMD_OBS/CMD_QUORUM/CMD_JOURNAL membership against."""
+    idx = _name_index(graph)
+    cov: dict[str, dict[str, list[Arm]]] = {}
+    for path_name, suffix, fname in PATHS:
+        roots = _roots(graph, suffix, fname)
+        if not roots:
+            continue
+        arms_by_cmd: dict[str, list[Arm]] = {}
+        reach = _serving_reach(graph, roots, ARM_DEPTH, idx)
+        for qual in sorted(reach):
+            fi = graph.funcs.get(qual)
+            if fi is None or not any(fi.module.endswith(s)
+                                     for s in SERVING_SUFFIXES):
+                continue
+            for arm in collect_arms(fi.node, fi.module, qual):
+                arms_by_cmd.setdefault(arm.cmd, []).append(arm)
+        cov[path_name] = arms_by_cmd
+    return cov
+
+
+def route_coverage(graph: CallGraph) -> dict[str, dict[str, list[Arm]]]:
+    """Routing surface arms (the surface function only, no BFS)."""
+    cov: dict[str, dict[str, list[Arm]]] = {}
+    for route_name, suffix, fname in ROUTES:
+        arms_by_cmd: dict[str, list[Arm]] = {}
+        for qual in _roots(graph, suffix, fname):
+            fi = graph.funcs[qual]
+            for arm in collect_arms(fi.node, fi.module, qual):
+                arms_by_cmd.setdefault(arm.cmd, []).append(arm)
+        if arms_by_cmd:
+            cov[route_name] = arms_by_cmd
+    return cov
+
+
+def check_parity(graph: CallGraph, root: Path) -> list[Finding]:
+    protocol_py = root / "rabit_tpu" / "tracker" / "protocol.py"
+    consts = wire.python_wire_consts(protocol_py)
+    universe = {name: line for name, (_val, line) in consts.items()
+                if name.startswith("CMD_")}
+    protocol_rel = "rabit_tpu/tracker/protocol.py"
+
+    cov = path_coverage(graph)
+    if len(cov) < 2:
+        return []   # a tree with one serving path has nothing to diverge
+    exempt = load_exemptions(protocol_py)
+    findings: list[Finding] = []
+
+    served_somewhere = {cmd for arms in cov.values() for cmd in arms
+                        if cmd in universe}
+
+    # 1. coverage closure: served somewhere => served (or exempt)
+    # everywhere
+    for cmd in sorted(served_somewhere):
+        holders = sorted(p for p in cov if cmd in cov[p])
+        for path_name in sorted(cov):
+            if cmd in cov[path_name]:
+                continue
+            if cmd in exempt.get(path_name, {}):
+                continue
+            findings.append(Finding(
+                rule=RULE_UNSERVED, path=protocol_rel,
+                line=universe.get(cmd, 1),
+                message=(f"{cmd} is served at {'/'.join(holders)} but "
+                         f"not at the {path_name} path and no "
+                         f"PARITY_EXEMPT entry declares the asymmetry "
+                         f"— the command silently vanishes in that "
+                         f"topology"),
+                token=f"{cmd}:{path_name}"))
+
+    # 2. the exemption ledger stays honest
+    for path_name, entries in sorted(exempt.items()):
+        if path_name not in cov:
+            for cmd, (_why, line) in sorted(entries.items()):
+                findings.append(Finding(
+                    rule=RULE_STALE, path=protocol_rel, line=line,
+                    message=(f"PARITY_EXEMPT names unknown serving path "
+                             f"{path_name!r} — the path roots moved or "
+                             f"the entry is a typo"),
+                    token=f"{cmd}:{path_name}:unknown-path"))
+            continue
+        for cmd, (_why, line) in sorted(entries.items()):
+            if cmd in cov[path_name]:
+                findings.append(Finding(
+                    rule=RULE_STALE, path=protocol_rel, line=line,
+                    message=(f"PARITY_EXEMPT says {cmd} is not served "
+                             f"at the {path_name} path, but it is — "
+                             f"the exemption outlived the asymmetry; "
+                             f"drop it"),
+                    token=f"{cmd}:{path_name}"))
+            elif cmd not in universe:
+                findings.append(Finding(
+                    rule=RULE_STALE, path=protocol_rel, line=line,
+                    message=(f"PARITY_EXEMPT names {cmd} which is not a "
+                             f"wire constant — rename drift"),
+                    token=f"{cmd}:{path_name}:unknown-cmd"))
+
+    # 3. journal side-effect parity per served command
+    idx = _name_index(graph)
+    effect: dict[tuple[str, str], set[str]] = {}
+    for path_name, arms_by_cmd in cov.items():
+        for cmd, arms in arms_by_cmd.items():
+            if cmd not in universe:
+                continue
+            kinds: set[str] = set()
+            for arm in arms:
+                kinds |= _arm_effect_kinds(graph, arm, idx)
+            effect[(path_name, cmd)] = kinds
+    for cmd in sorted(served_somewhere):
+        holders = sorted(p for p in cov if cmd in cov[p])
+        if len(holders) < 2:
+            continue
+        sets = {p: effect.get((p, cmd), set()) for p in holders}
+        union = set().union(*sets.values())
+        for path_name in holders:
+            missing = union - sets[path_name]
+            if not missing:
+                continue
+            arm = min(cov[path_name][cmd], key=lambda a: a.line)
+            others = [p for p in holders
+                      if sets[p] >= union and p != path_name]
+            findings.append(Finding(
+                rule=RULE_DIVERGE, path=arm.module, line=arm.line,
+                message=(f"{cmd} at the {path_name} path journals "
+                         f"{sorted(sets[path_name]) or '{}'} but "
+                         f"{'/'.join(others) or '/'.join(holders)} also "
+                         f"journals {sorted(missing)} — a standby "
+                         f"replaying after failover diverges on which "
+                         f"path served the command"),
+                token=f"{cmd}:{path_name}"))
+
+    # 4. routing arms must route to something served
+    for route_name, arms_by_cmd in sorted(route_coverage(graph).items()):
+        for cmd, arms in sorted(arms_by_cmd.items()):
+            if cmd in universe and cmd not in served_somewhere:
+                arm = min(arms, key=lambda a: a.line)
+                findings.append(Finding(
+                    rule=RULE_ROUTE, path=arm.module, line=arm.line,
+                    message=(f"{route_name} special-cases {cmd} but no "
+                             f"serving path handles it — dead routing "
+                             f"arm (rename drift or a removed command)"),
+                    token=f"{cmd}:{route_name}"))
+    return findings
