@@ -134,6 +134,14 @@ SERVICE_CHILD_TIMEOUT = 180.0
 # like the other riders; RABIT_BENCH_OBS=0 skips it.
 OBS_BENCH = os.environ.get("RABIT_BENCH_OBS", "1") != "0"
 OBS_CHILD_TIMEOUT = 90.0
+# Model-delivery plane (ISSUE 20): the snapshot-CDN smoke
+# (tools/delivery_bench.py --smoke; doc/delivery.md) — a live writer
+# against a simulated subscriber swarm through relays (propagation
+# p50/p99, writer-cadence ratio), the cross-tenant dedup uplink row, and
+# a mid-stream tracker failover — in a CPU child; deducted from the TPU
+# budget like the other riders; RABIT_BENCH_DELIVERY=0 skips it.
+DELIVERY_BENCH = os.environ.get("RABIT_BENCH_DELIVERY", "1") != "0"
+DELIVERY_CHILD_TIMEOUT = 180.0
 # Regression sentinel (ISSUE 18): every driver record carries the
 # high-water verdict over the existing BENCH_*/RESULTS trajectory
 # (tools/bench_sentinel.py), so a silent perf erasure — the r03-r05
@@ -593,6 +601,35 @@ def run_service_bench(timeout=SERVICE_CHILD_TIMEOUT):
             log(f"service bench child rc={r.returncode}")
     except subprocess.TimeoutExpired:
         log(f"service bench child timed out after {timeout:.0f}s")
+    return lines
+
+
+def run_delivery_bench(timeout=DELIVERY_CHILD_TIMEOUT):
+    """Model-delivery records (tools/delivery_bench.py --smoke) in a
+    child: a live writer publishing snapshots against a selector-driven
+    subscriber swarm through two relays, the tenants-x-identical-bytes
+    dedup uplink row, and a mid-stream tracker failover (threads + real
+    sockets; a child so a wedged run cannot stall the driver).  Returns
+    the record list, empty on timeout/failure."""
+    cmd = [sys.executable,
+           os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "tools", "delivery_bench.py"), "--smoke"]
+    lines = []
+    try:
+        r = subprocess.run(cmd, timeout=timeout, capture_output=True,
+                           text=True)
+        if r.returncode == 0:
+            for line in r.stdout.strip().splitlines():
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if isinstance(rec, dict) and rec.get("bench") == "delivery":
+                    lines.append(rec)
+        else:
+            log(f"delivery bench child rc={r.returncode}")
+    except subprocess.TimeoutExpired:
+        log(f"delivery bench child timed out after {timeout:.0f}s")
     return lines
 
 
@@ -1223,6 +1260,14 @@ def main():
                          min(tpu_budget, 300.0))
         log(f"live metrics bench: {len(obs_lines)} line(s); "
             f"TPU budget now {tpu_budget:.0f}s")
+    delivery_lines = []
+    if DELIVERY_BENCH:
+        t_dl = time.time()
+        delivery_lines = run_delivery_bench()
+        tpu_budget = max(tpu_budget - (time.time() - t_dl),
+                         min(tpu_budget, 300.0))
+        log(f"delivery bench: {len(delivery_lines)} line(s); "
+            f"TPU budget now {tpu_budget:.0f}s")
     probe_daemon = ProbeDaemon().start()
     # start paused: attempt 1 launches immediately and owns the chip; the
     # child's teardown resumes the cadence for the probe-gated retries
@@ -1274,6 +1319,8 @@ def main():
             rec["service"] = service_lines
         if obs_lines:
             rec["live_metrics"] = obs_lines
+        if delivery_lines:
+            rec["delivery"] = delivery_lines
         sv = sentinel_verdict()
         if sv is not None:
             rec["sentinel"] = sv
@@ -1341,6 +1388,8 @@ def main():
         rec["service"] = service_lines
     if obs_lines:
         rec["live_metrics"] = obs_lines
+    if delivery_lines:
+        rec["delivery"] = delivery_lines
     sv = sentinel_verdict()
     if sv is not None:
         rec["sentinel"] = sv

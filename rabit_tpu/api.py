@@ -36,6 +36,11 @@ _engine: Engine | None = None
 _ckpt_store = None
 _ckpt_base = 0
 
+# Delivery-plane publisher (rabit_tpu/delivery, doc/delivery.md): built at
+# init() on rank 0 when rabit_delivery_publish=1, it registers every
+# checkpoint commit as a content-addressed snapshot with the tracker.
+_publisher = None
+
 # Elastic-world state (rabit_tpu/elastic, doc/elasticity.md): the world
 # epoch this process last adopted, and the shard-rebalance callbacks run
 # when it changes.  The epoch is stamped into durable checkpoint frames
@@ -152,7 +157,7 @@ def init(args: list[str] | None = None, **overrides: Any) -> None:
         rank=_engine.get_rank(),
         world=_engine.get_world_size(),
     )
-    global _ckpt_store, _ckpt_base, _world_epoch
+    global _ckpt_store, _ckpt_base, _world_epoch, _publisher
     _ckpt_base = 0
     _world_epoch = {"epoch": 0, "world_size": _engine.get_world_size()}
     ckpt_dir = cfg.get("rabit_checkpoint_dir", "") or ""
@@ -163,13 +168,30 @@ def init(args: list[str] | None = None, **overrides: Any) -> None:
                                       codec=pol.checkpoint)
     else:
         _ckpt_store = None
+    # Delivery plane (doc/delivery.md): rank 0 publishes each commit's
+    # bytes content-addressed through the tracker.  Only the committing
+    # rank publishes — every rank holds the same global blob, and N
+    # identical publishes would be N redundant digest registrations.
+    _publisher = None
+    uri = cfg.get("rabit_tracker_uri", "NULL") or "NULL"
+    if (cfg.get_bool("rabit_delivery_publish") and uri != "NULL"
+            and _engine.get_rank() == 0):
+        from rabit_tpu.delivery import Publisher
+        from rabit_tpu.tracker.protocol import parse_addrs
+
+        _publisher = Publisher(
+            uri, cfg.get_int("rabit_tracker_port", 9091),
+            job=cfg.get("rabit_job_key", "") or "",
+            task_id=f"pub-{cfg.get('rabit_task_id', '0')}",
+            addrs=parse_addrs(cfg.get("rabit_tracker_addrs", "") or ""),
+        )
 
 
 def finalize() -> None:
     """Shut down the engine (reference: RabitFinalize).  Ships the final
     metrics snapshot to the tracker first — the tracker keeps serving until
     every rank's shutdown handshake, so the snapshot always lands."""
-    global _engine, _ckpt_store, _ckpt_base, _world_epoch
+    global _engine, _ckpt_store, _ckpt_base, _world_epoch, _publisher
     if _engine is not None:
         obs.ship_final_snapshot()
         obs.record_event("engine_finalize", engine=type(_engine).__name__)
@@ -182,6 +204,7 @@ def finalize() -> None:
     _ckpt_store = None
     _ckpt_base = 0
     _world_epoch = {"epoch": 0, "world_size": 1}
+    _publisher = None
 
 
 def world_epoch() -> dict:
@@ -504,6 +527,7 @@ def checkpoint(global_model: Any, local_model: Any = None) -> None:
     if _ckpt_store is None:
         engine.checkpoint(gblob, lblob)
         _note_commit(engine, len(gblob))
+        _publish_commit(engine, gblob)
         return
     wrapped = _wrap(_ckpt_base, gblob)
     engine.checkpoint(wrapped, lblob)
@@ -515,6 +539,28 @@ def checkpoint(global_model: Any, local_model: Any = None) -> None:
     # resize stays deterministic (doc/elasticity.md).
     _ckpt_store.save(_ckpt_base + engine.version_number(), wrapped, lblob,
                      epoch=_world_epoch["epoch"])
+    _publish_commit(engine, wrapped)
+
+
+def _publish_commit(engine: Engine, blob: bytes) -> None:
+    """Delivery-plane publish seam (doc/delivery.md): register the
+    committed blob with the tracker AFTER commit (and after the durable
+    spill, when on) so the plane only ever advertises bytes a resume
+    could also serve.  Publishing is best-effort — a delivery outage
+    must never fail the training job's commit."""
+    if _publisher is None:
+        return
+    version = _ckpt_base + engine.version_number()
+    try:
+        _publisher.publish(version, blob, epoch=_world_epoch["epoch"])
+        if _ckpt_store is not None:
+            # Pin what subscribers were just told about: the retention
+            # prune must not race a fetch-in-flight of this version.
+            _ckpt_store.pin(version)
+        obs.record_event("snapshot_published", version=version,
+                         nbytes=len(blob))
+    except (ConnectionError, OSError, ValueError):
+        pass
 
 
 def lazy_checkpoint(global_model: Any) -> None:

@@ -249,6 +249,19 @@ DEFAULTS: dict[str, str] = {
     "rabit_diag_link_share": "0.5",
     "rabit_diag_hole_ratio": "0.25",
     "rabit_diag_storm_leases": "3",
+    # Model-delivery plane (rabit_tpu/delivery, doc/delivery.md).
+    # rabit_delivery_publish=1: rank 0 publishes every checkpoint commit
+    # as a content-addressed snapshot (version line + digest-deduped
+    # bytes) through the tracker.  rabit_delivery_poll_sec: subscriber
+    # poll/retry cadence.  rabit_relay_cache_bytes: each relay's
+    # digest-keyed snapshot cache budget (LRU beyond it; live jobs'
+    # newest digests are never evicted).  rabit_checkpoint_keep: the
+    # durable store's retention window (versions beyond the newest N
+    # prune after each commit; the published version stays pinned).
+    "rabit_delivery_publish": "0",
+    "rabit_delivery_poll_sec": "0.5",
+    "rabit_relay_cache_bytes": "256M",
+    "rabit_checkpoint_keep": "2",
 }
 
 

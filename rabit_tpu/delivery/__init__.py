@@ -1,0 +1,237 @@
+"""Model-delivery plane — the checkpoint store as a content-addressed
+snapshot CDN (doc/delivery.md).
+
+Every byte the system shipped before this package moved on the WRITE
+path: training jobs commit RTC3 checkpoints, relays cache bootstrap
+blobs, nothing ever read a model back out at scale.  This package adds
+the read side:
+
+* :class:`Publisher` — the writer's seam, riding the checkpoint commit
+  (``rabit_tpu.api.checkpoint`` with ``rabit_delivery_publish=1``).
+  Each commit registers ``(version, epoch, digest, size)`` with the
+  tracker (``CMD_SUB publish`` — journaled as ``snapshot_published``,
+  so a standby restores the version line) and uploads the snapshot
+  bytes only when the reply says the content digest is not already
+  held: N tenants publishing identical bytes ship them ONCE.
+
+* :class:`Subscriber` — the reader: poll the current version line
+  (``CMD_SUB``, answered relay-locally from the batch-ACK-refreshed
+  cache), fetch the snapshot in chunks (``CMD_SNAP``, served from the
+  relay's digest-keyed cache after the first fetch), verify the
+  content digest end to end, and rotate through
+  ``rabit_tracker_addrs`` across a tracker failover.  A missed version
+  is not an error — the subscriber converges on the NEWEST line
+  (catch-up semantics), and an empty snap frame (bytes not yet landed,
+  or not yet re-pushed after a failover) is a retryable race.
+
+The wire is the ordinary tracker protocol, so subscribers point at a
+relay exactly like workers do and the root's accept load stays
+O(relays) while subscribers are O(10^5).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import socket
+import time
+
+from rabit_tpu.config import Config
+from rabit_tpu.tracker import protocol as P
+
+#: Default fetch window: large enough to amortize the RPC, small enough
+#: that a slow subscriber never pins a relay reply buffer.
+CHUNK_BYTES = 1 << 20
+
+_EMPTY_LINE = {"version": 0, "epoch": 0, "digest": "", "size": 0}
+
+
+def digest_of(blob: bytes) -> str:
+    """The content address of one snapshot: sha256 hex of its bytes.
+    The tracker recomputes it server-side on upload, so the store is
+    self-certifying — a publisher cannot register bytes under a digest
+    that does not match them."""
+    return hashlib.sha256(blob).hexdigest()
+
+
+class Publisher:
+    """The write side of the delivery plane (module docstring).
+
+    ``publish()`` is register-then-upload-if-needed: the version line
+    lands (and journals) first, the bytes follow only on a digest miss.
+    The tiny window where the line is ahead of the bytes is part of the
+    contract — subscribers treat an empty fetch as retryable.
+    """
+
+    def __init__(self, host: str, port: int, job: str = "",
+                 task_id: str = "pub0",
+                 addrs: list[tuple[str, int]] | None = None,
+                 timeout: float = 10.0, retries: int = 5):
+        self.host, self.port = host, int(port)
+        self.job = job
+        self.task_id = task_id
+        self.addrs = [(a[0], int(a[1])) for a in (addrs or [])]
+        self.timeout = float(timeout)
+        self.retries = max(int(retries), 0)
+        #: the last line this publisher registered (evidence/tests)
+        self.published: dict | None = None
+        self.uploads = 0      # uploads actually shipped
+        self.dedup_skips = 0  # uploads skipped (digest already held)
+
+    def publish(self, version: int, blob: bytes, epoch: int = 0) -> dict:
+        """Register one committed snapshot; returns the tracker's line
+        reply (including the ``have`` dedup bit).  Raises
+        :class:`~rabit_tpu.tracker.protocol.TrackerUnreachable` when no
+        configured address answers."""
+        line = {"version": int(version), "epoch": int(epoch),
+                "digest": digest_of(blob), "size": len(blob)}
+        reply = P.tracker_rpc(
+            self.host, self.port, P.CMD_SUB, self.task_id,
+            message=json.dumps({"publish": line}),
+            timeout=self.timeout, retries=self.retries,
+            addrs=self.addrs, job=self.job)
+        if not isinstance(reply, dict):
+            reply = dict(line, have=False)
+        if reply.get("have"):
+            self.dedup_skips += 1
+        else:
+            self._upload(line["version"], blob)
+            self.uploads += 1
+        self.published = line
+        return reply
+
+    def _upload(self, version: int, blob: bytes) -> None:
+        """Ship the snapshot bytes (CMD_BLOB — the existing proxied,
+        relay-cached upload path; the tracker stores them digest-keyed).
+        Rotates through the failover list like every client RPC."""
+        cands = [(self.host, self.port)]
+        for a in self.addrs:
+            if a not in cands:
+                cands.append(a)
+        last: Exception | None = None
+        for attempt in range(self.retries + 1):
+            host, port = cands[attempt % len(cands)]
+            try:
+                with socket.create_connection(
+                        (host, port), timeout=self.timeout) as sock:
+                    sock.settimeout(self.timeout)
+                    P.send_hello(sock, P.CMD_BLOB,
+                                 P.join_job(self.job, self.task_id),
+                                 blob=blob, blob_version=int(version))
+                    if P.get_u32(sock) == P.ACK:
+                        return
+            except (ConnectionError, OSError, ValueError) as exc:
+                last = exc
+            if attempt < self.retries:
+                time.sleep(min(0.1 * (2 ** attempt), 1.0))
+        raise P.TrackerUnreachable(
+            f"snapshot upload v{version} failed after "
+            f"{self.retries + 1} attempt(s); last error: {last!r}")
+
+
+class Subscriber:
+    """The read side of the delivery plane (module docstring)."""
+
+    def __init__(self, host: str, port: int, job: str = "",
+                 task_id: str = "sub0",
+                 addrs: list[tuple[str, int]] | None = None,
+                 timeout: float = 10.0, retries: int = 5,
+                 chunk_bytes: int = CHUNK_BYTES,
+                 poll_sec: float | None = None):
+        self.host, self.port = host, int(port)
+        self.job = job
+        self.task_id = task_id
+        self.addrs = [(a[0], int(a[1])) for a in (addrs or [])]
+        self.timeout = float(timeout)
+        self.retries = max(int(retries), 0)
+        self.chunk_bytes = max(int(chunk_bytes), 1)
+        if poll_sec is None:
+            poll_sec = float(Config().get("rabit_delivery_poll_sec",
+                                          "0.5") or "0.5")
+        self.poll_sec = max(float(poll_sec), 0.01)
+        #: newest version this subscriber has fully fetched
+        self.seen_version = 0
+
+    def poll(self) -> dict:
+        """The current published version line (``version`` 0 = nothing
+        published yet)."""
+        reply = P.tracker_rpc(
+            self.host, self.port, P.CMD_SUB, self.task_id, message="{}",
+            timeout=self.timeout, retries=self.retries,
+            addrs=self.addrs, job=self.job)
+        return reply if isinstance(reply, dict) else dict(_EMPTY_LINE)
+
+    def wait_for(self, min_version: int | None = None,
+                 deadline_sec: float = 30.0) -> dict:
+        """Block (poll-cadence) until the published line reaches
+        ``min_version`` (default: anything newer than ``seen_version``).
+        Catch-up semantics: a subscriber that slept through versions
+        5..9 wakes to the line naming 10 — intermediate versions are
+        not replayed, the stream converges on the newest snapshot."""
+        target = (int(min_version) if min_version is not None
+                  else self.seen_version + 1)
+        deadline = time.monotonic() + float(deadline_sec)
+        while True:
+            line = self.poll()
+            if int(line.get("version", 0)) >= target:
+                return line
+            if time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"delivery line never reached v{target} "
+                    f"(currently v{line.get('version', 0)})")
+            time.sleep(self.poll_sec)
+
+    def fetch(self, line: dict | None = None,
+              deadline_sec: float = 30.0) -> tuple[dict, bytes]:
+        """Fetch the snapshot the line names (default: the current
+        line), chunk by chunk, and verify its content digest.  Empty
+        frames — the publish-before-upload race, or a fresh standby
+        whose byte store has not been re-fed — retry until the
+        deadline.  Returns ``(line, blob)``."""
+        if line is None:
+            line = self.poll()
+        digest = str(line.get("digest", ""))
+        if not digest:
+            raise LookupError("nothing published yet (empty digest)")
+        deadline = time.monotonic() + float(deadline_sec)
+        while True:
+            blob = self._fetch_once(digest)
+            if blob is not None and digest_of(blob) == digest:
+                self.seen_version = max(self.seen_version,
+                                        int(line.get("version", 0)))
+                return dict(line), blob
+            if time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"snapshot {digest[:12]}… not served within "
+                    f"{deadline_sec:.1f}s")
+            time.sleep(self.poll_sec)
+
+    def _fetch_once(self, digest: str) -> bytes | None:
+        """One whole-blob fetch attempt; None on absence or a torn
+        window sequence (the caller retries — absence is a race, not an
+        error)."""
+        buf = bytearray()
+        off = 0
+        total: int | None = None
+        while True:
+            try:
+                got, tot, goff, chunk = P.tracker_rpc(
+                    self.host, self.port, P.CMD_SNAP, self.task_id,
+                    message=json.dumps({"digest": digest, "off": off,
+                                        "len": self.chunk_bytes}),
+                    timeout=self.timeout, retries=self.retries,
+                    addrs=self.addrs, job=self.job)
+            except P.TrackerUnreachable:
+                return None
+            if got != digest or goff != off:
+                return None  # absent, or the holder changed mid-fetch
+            if total is None:
+                total = tot
+            elif tot != total:
+                return None
+            buf += chunk
+            off += len(chunk)
+            if off >= total:
+                return bytes(buf)
+            if not chunk:
+                return None  # short frame with bytes still owed

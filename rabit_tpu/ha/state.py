@@ -61,6 +61,11 @@ class ControlState:
         self.link_flags: list[list[str]] = []  # [[src_task, dst_task], ...]
         self.sched_algo = ""
         self.last_ring: list[int] = []
+        # model-delivery version line (doc/delivery.md): the newest
+        # published {version, epoch, digest, size}, or {} before any
+        # publish.  The snapshot BYTES are deliberately not journaled —
+        # the publisher re-pushes after its next commit.
+        self.delivery: dict = {}
         # quorum ledgers, mirroring rabit_tpu.quorum.QuorumTable
         self.q_records: dict[str, dict] = {}       # "epoch:v" -> record
         self.q_outstanding: dict[str, int] = {}    # "sv:rank" -> world
@@ -143,6 +148,12 @@ class ControlState:
     def _apply_blob(self, f: dict) -> None:
         self.blob_version = max(self.blob_version, int(f["version"]))
 
+    def _apply_snapshot_published(self, f: dict) -> None:
+        line = {"version": int(f["version"]), "epoch": int(f["epoch"]),
+                "digest": str(f["digest"]), "size": int(f["size"])}
+        if line["version"] >= int(self.delivery.get("version", 0)):
+            self.delivery = line
+
     def _apply_quorum_freeze(self, f: dict) -> None:
         """A round's exclusion record froze: mirror QuorumTable.report's
         decided branch (corrections retired, exclusions outstanding,
@@ -193,6 +204,7 @@ class ControlState:
             "link_flags": sorted(list(p) for p in self.link_flags),
             "sched_algo": self.sched_algo,
             "last_ring": list(self.last_ring),
+            "delivery": dict(self.delivery),
             "q_records": {k: dict(r) for k, r in self.q_records.items()},
             "q_outstanding": dict(self.q_outstanding),
             "q_late_seen": sorted(self.q_late_seen),
@@ -226,6 +238,7 @@ class ControlState:
                                   for a, b in snap.get("link_flags", ()))
         fresh.sched_algo = str(snap.get("sched_algo", ""))
         fresh.last_ring = [int(r) for r in snap.get("last_ring", ())]
+        fresh.delivery = dict(snap.get("delivery", {}))
         fresh.q_records = {str(k): dict(r)
                            for k, r in snap.get("q_records", {}).items()}
         fresh.q_outstanding = {str(k): int(w) for k, w in

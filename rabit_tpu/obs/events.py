@@ -159,6 +159,17 @@ KINDS: dict[str, str] = {
     "critical_path_folded": "trace_tool diagnose folded a critical-path "
                             "report into telemetry.json: rounds, links, "
                             "ranks",
+    # model-delivery plane (rabit_tpu/delivery, doc/delivery.md)
+    "snapshot_published": "a checkpoint commit registered as a "
+                          "content-addressed snapshot: version, epoch, "
+                          "digest, size (journaled so a standby restores "
+                          "the version line)",
+    "snapshot_fetched": "first CMD_SNAP fetch of a digest served: "
+                        "digest, nbytes (per-fetch byte counts stream as "
+                        "delivery_bytes_served)",
+    "blob_cache_evicted": "a relay's digest-keyed snapshot cache dropped "
+                          "an entry: digest, nbytes, reason "
+                          "(lru|superseded|job_retired)",
 }
 
 

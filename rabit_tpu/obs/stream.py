@@ -59,6 +59,15 @@ STREAM_METRICS: dict[str, str] = {
     "link_wait_seconds": "per-planned-link receive wait, labeled "
                          "src=<rank>,dst=<rank> (ElasticWorker ring "
                          "timers; the route-around loop's health signal)",
+    # model-delivery plane (rabit_tpu/delivery, doc/delivery.md)
+    "delivery_bytes_served": "snapshot bytes the tracker served over "
+                             "CMD_SNAP, labeled job=<job>,digest=<hex>",
+    "delivery_subscribers": "distinct subscriber task ids seen on the "
+                            "CMD_SUB poll path, labeled job=<job>",
+    "delivery_cache_hits": "relay-local CMD_SNAP fetches answered from "
+                           "the digest cache, labeled relay=<id>",
+    "delivery_cache_misses": "CMD_SNAP fetches the relay had to proxy "
+                             "upstream, labeled relay=<id>",
 }
 
 

@@ -155,6 +155,20 @@ def render(doc: dict, prev: dict | None = None, top_links: int = 6) -> str:
             per = "  ".join(f"{c}={_fmt_bytes(b)}"
                             for c, b in sorted(split.items()))
             lines.append(f"{'':<10} {'':<12} codecs: {per}")
+        # delivery pane (doc/delivery.md): the published version line and
+        # the content-addressed store behind it, when the job has one
+        delivery = jstate.get("delivery")
+        if isinstance(delivery, dict) and (delivery.get("line")
+                                           or delivery.get("subscribers")):
+            dline = delivery.get("line") or {}
+            lines.append(
+                f"{'':<10} {'':<12} delivery: "
+                f"v{dline.get('version', 0)} "
+                f"digest={str(dline.get('digest', ''))[:12] or '-'} "
+                f"size={_fmt_bytes(float(dline.get('size', 0)))} "
+                f"snaps={delivery.get('snaps', 0)}"
+                f"({_fmt_bytes(float(delivery.get('snap_bytes', 0)))}) "
+                f"subs={delivery.get('subscribers', 0)}")
         stragglers = _straggler_rows(jstate)
         if stragglers:
             per = "  ".join(

@@ -370,6 +370,7 @@ _TRACKER_INSTANTS = {
     "job_completed",
     "obs_scrape", "metrics_delta_folded",
     "incident_opened", "incident_resolved", "critical_path_folded",
+    "snapshot_published", "snapshot_fetched", "blob_cache_evicted",
 }
 
 

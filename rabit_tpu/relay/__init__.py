@@ -71,12 +71,15 @@ relay tier IS their stable coordination address.
 
 from __future__ import annotations
 
+import hashlib
 import json
 import selectors
 import socket
 import threading
 import time
+from collections import OrderedDict
 
+from rabit_tpu.config import Config
 from rabit_tpu.obs import stream as obs_stream
 from rabit_tpu.tracker import protocol as P
 
@@ -185,13 +188,28 @@ class Relay:
         # (task id "j/0") get their CMD_EPOCH polls answered from their
         # OWN job's cache, so one shared relay tier serves every job.
         self._job_epochs: dict[str, dict] = {}
-        # Relay-side bootstrap-blob cache, per job: (version, bytes) of
-        # the newest CMD_BLOB upload seen.  A same-or-older-version
-        # upload is ACKed LOCALLY (blob_cache_hits) — N children
-        # re-shipping one blob cost the root ONE proxied upload; a
-        # version bump invalidates (replaces) the entry and passes
-        # through.
-        self._blob_cache: dict[str, tuple[int, bytes]] = {}
+        # Relay-side blob cache, DIGEST-KEYED (doc/delivery.md): the
+        # bytes live once in ``_digest_blobs`` (LRU order, bounded by
+        # the ``rabit_relay_cache_bytes`` byte budget) and every job's
+        # ``_blob_cache`` entry maps job -> (version, digest) —
+        # refcounted via ``_digest_refs`` so N jobs shipping identical
+        # bytes hold ONE copy, and a retired job releases only its
+        # reference.  A same-or-older-version upload is ACKed LOCALLY
+        # (blob_cache_hits); a version bump releases the superseded
+        # digest and proxies through.  CMD_SNAP fetches populate the
+        # same store (unreferenced — pure LRU tenants).
+        self._blob_cache: dict[str, tuple[int, str]] = {}
+        self._digest_blobs: OrderedDict[str, bytes] = OrderedDict()
+        self._digest_refs: dict[str, int] = {}
+        self._cache_used = 0
+        self._cache_budget = Config().get_size("rabit_relay_cache_bytes",
+                                               256 << 20)
+        # Per-job delivery version lines, refreshed from batch ACKs
+        # (doc/delivery.md): a known line answers a child's CMD_SUB poll
+        # locally — 10^5 subscribers polling never touch the root.
+        self._sub_lines: dict[str, dict] = {}
+        # Local evidence timeline (blob_cache_evicted), bounded.
+        self.events: list[dict] = []
         # The last batch's replayable sub-messages, held until its ACK
         # lands: a channel cut between send and ACK (a root failover)
         # replays them on the next connect so no check-in, shutdown,
@@ -207,7 +225,8 @@ class Relay:
         self.stats = {"children": 0, "rpcs_terminated": 0, "batches": 0,
                       "batch_msgs": 0, "routed": 0, "reconnects": 0,
                       "failovers": 0, "replayed_msgs": 0,
-                      "blob_cache_hits": 0}
+                      "blob_cache_hits": 0, "snap_cache_hits": 0,
+                      "snap_proxies": 0, "evictions": 0}
 
     @property
     def tracker(self) -> tuple[str, int]:
@@ -455,6 +474,71 @@ class Relay:
                              daemon=True,
                              name=f"relay-proxy-{self.relay_id}").start()
             return
+        if h.cmd == P.CMD_SUB:
+            # Delivery version-line poll (doc/delivery.md): a known line
+            # (ack-refreshed, per job) answers LOCALLY — the subscriber
+            # swarm's polls never touch the root.  Publishes, and polls
+            # before any ACK named this job's line, park the child and
+            # ride the next immediate batch; the tracker routes the
+            # reply back under the s#-prefixed key (the quorum shape).
+            job, _rest = P.split_job(h.task_id)
+            with self._lock:
+                line = self._sub_lines.get(job)
+            if line is not None and "publish" not in h.message:
+                self.stats["rpcs_terminated"] += 1
+                ch.out += P.put_u32(P.ACK) + P.put_str(json.dumps(line))
+                self._child_flush(sel, children, ch)
+                return
+            ch.held = True
+            ch.deadline = 0.0
+            key = "s#" + h.task_id
+            ch.task_id = key
+            msg = P.BatchMsg(key, P.CMD_SUB, h.prev_rank, ch.addr[0],
+                             0, h.message.encode(), time.time())
+            with self._lock:
+                old = self._held.pop(key, None)
+                self._held[key] = ch.sock
+                self._held_msg[key] = msg
+                self._held_sent.discard(key)
+            if old is not None and old is not ch.sock:
+                with self._lock:
+                    self._defer_close.add(old)
+            self._flush_now.set()
+            return
+        if h.cmd == P.CMD_SNAP:
+            # Snapshot chunk fetch (doc/delivery.md): a cached digest is
+            # sliced and answered locally (the CDN hit — repeat digests
+            # cost the root nothing); a miss detaches to a proxy thread
+            # that fetches the WHOLE blob once, caches it digest-keyed,
+            # and answers the requested window.  Pure bytes math on the
+            # hit path — the child reactor never blocks.
+            try:
+                req = json.loads(h.message) if h.message else {}
+            except ValueError:
+                req = {}
+            if not isinstance(req, dict):
+                req = {}
+            digest = str(req.get("digest", ""))
+            with self._lock:
+                blob = self._digest_blobs.get(digest)
+                if blob is not None:
+                    self._digest_blobs.move_to_end(digest)
+            if blob is not None:
+                self.stats["snap_cache_hits"] += 1
+                self.stats["rpcs_terminated"] += 1
+                obs_stream.stream_count("delivery_cache_hits", 1,
+                                        relay=self.relay_id)
+                ch.out += self._snap_window(digest, blob, req)
+                self._child_flush(sel, children, ch)
+                return
+            self.stats["snap_proxies"] += 1
+            obs_stream.stream_count("delivery_cache_misses", 1,
+                                    relay=self.relay_id)
+            self._child_detach(sel, children, ch)
+            threading.Thread(target=self._proxy_snap,
+                             args=(ch.sock, h, req), daemon=True,
+                             name=f"relay-snap-{self.relay_id}").start()
+            return
         self.stats["rpcs_terminated"] += 1
         if h.cmd == P.CMD_HEARTBEAT:
             try:
@@ -570,13 +654,116 @@ class Relay:
     def _proxy_blob(self, conn: socket.socket, h: P.Hello,
                     job: str) -> None:
         """Proxy one blob upload and — only once the root ACKed — cache
-        it for (job, version): a cache entry must never swallow
-        re-uploads of a blob the root never received."""
+        it digest-keyed for (job, version): a cache entry must never
+        swallow re-uploads of a blob the root never received."""
         if self._proxy_rpc(conn, h) and h.blob_version > 0:
-            with self._lock:
-                cached = self._blob_cache.get(job)
-                if cached is None or h.blob_version >= cached[0]:
-                    self._blob_cache[job] = (h.blob_version, h.blob)
+            digest = hashlib.sha256(h.blob).hexdigest()
+            self._cache_put(digest, h.blob, job=job,
+                            version=h.blob_version)
+
+    def _proxy_snap(self, conn: socket.socket, h: P.Hello,
+                    req: dict) -> None:
+        """Fetch one digest's WHOLE snapshot from the root on a detached
+        thread, cache it digest-keyed, and answer the child's requested
+        window.  The whole-blob fetch is the dedup lever: every later
+        subscriber asking for this digest is served locally.  A missing
+        digest relays the root's empty frame — absence is retryable,
+        never an error (doc/delivery.md)."""
+        digest = str(req.get("digest", ""))
+        blob = None
+        try:
+            try:
+                with socket.create_connection(
+                        self.tracker, timeout=self.rpc_timeout) as up:
+                    up.settimeout(self.rpc_timeout)
+                    P.send_hello(up, P.CMD_SNAP, h.task_id,
+                                 message=json.dumps({"digest": digest}))
+                    got, total, _off, payload = P.read_snap_frame(up)
+                if got == digest and payload:
+                    blob = payload
+                    if len(payload) == total:
+                        self._cache_put(digest, blob)
+            except (ConnectionError, OSError, ValueError):
+                pass
+            conn.settimeout(self.rpc_timeout)
+            if blob is None:
+                conn.sendall(P.put_snap_frame("", 0, 0, b""))
+            else:
+                conn.sendall(self._snap_window(digest, blob, req))
+        except OSError:
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    @staticmethod
+    def _snap_window(digest: str, blob: bytes, req: dict) -> bytes:
+        """One CMD_SNAP reply frame: the requested [off, off+len) window
+        of a cached blob (len 0 / absent = the rest of the blob)."""
+        try:
+            off = max(int(req.get("off", 0)), 0)
+            ln = int(req.get("len", 0) or 0)
+        except (TypeError, ValueError):
+            off, ln = 0, 0
+        chunk = blob[off:off + ln] if ln > 0 else blob[off:]
+        return P.put_snap_frame(digest, len(blob), off, chunk)
+
+    # -- digest-keyed cache bookkeeping (doc/delivery.md) ------------------
+
+    def _cache_put(self, digest: str, blob: bytes, job: str | None = None,
+                   version: int = 0) -> None:
+        """Insert one blob under its digest; optionally bind it as
+        ``job``'s current (version, digest) entry, releasing the
+        superseded digest.  Enforces the LRU byte budget by evicting
+        UNREFERENCED digests oldest-first — bytes a live job still
+        references are never dropped out from under a fetch."""
+        with self._lock:
+            if job is not None:
+                old = self._blob_cache.get(job)
+                self._blob_cache[job] = (version, digest)
+                if old is None or old[1] != digest:
+                    self._digest_refs[digest] = (
+                        self._digest_refs.get(digest, 0) + 1)
+                    if old is not None:
+                        self._release_digest_locked(old[1], "superseded")
+            if digest not in self._digest_blobs:
+                self._digest_blobs[digest] = blob
+                self._cache_used += len(blob)
+            else:
+                self._digest_blobs.move_to_end(digest)
+            while self._cache_used > self._cache_budget:
+                victim = next((d for d in self._digest_blobs
+                               if self._digest_refs.get(d, 0) <= 0
+                               and d != digest), None)
+                if victim is None:
+                    break
+                vb = self._digest_blobs.pop(victim)
+                self._cache_used -= len(vb)
+                self._note_evicted_locked(victim, len(vb), "lru")
+
+    def _release_digest_locked(self, digest: str, reason: str) -> None:
+        """Drop one reference; evict the bytes once no job holds one."""
+        n = self._digest_refs.get(digest, 1) - 1
+        if n > 0:
+            self._digest_refs[digest] = n
+            return
+        self._digest_refs.pop(digest, None)
+        blob = self._digest_blobs.pop(digest, None)
+        if blob is not None:
+            self._cache_used -= len(blob)
+            self._note_evicted_locked(digest, len(blob), reason)
+
+    def _note_evicted_locked(self, digest: str, nbytes: int,
+                             reason: str) -> None:
+        self.stats["evictions"] += 1
+        if len(self.events) < 4096:  # evidence, not a leak
+            self.events.append({
+                "ts": round(time.time(), 6), "kind": "blob_cache_evicted",
+                "relay": self.relay_id, "digest": digest,
+                "nbytes": nbytes, "reason": reason,
+            })
 
     def _expire_local_leases(self) -> None:
         """Drop local leases past LEASE_FACTOR x interval: the child is
@@ -705,6 +892,11 @@ class Relay:
             self._epoch_cache = {"epoch": info.get("epoch", 0),
                                  "world": info.get("world", 0),
                                  "rewave": bool(info.get("rewave"))}
+        line = info.get("delivery")
+        if isinstance(line, dict):
+            # single-job delivery line (bare task ids → job "")
+            with self._lock:
+                self._sub_lines[""] = dict(line)
         jobs = info.get("jobs")
         if isinstance(jobs, dict):
             # per-job epoch caches from a CollectiveService's ACK; one
@@ -714,6 +906,23 @@ class Relay:
                          "world": v.get("world", 0),
                          "rewave": bool(v.get("rewave"))}
                 for k, v in jobs.items() if isinstance(v, dict)}
+            sub_lines = {
+                str(k): dict(v["delivery"]) for k, v in jobs.items()
+                if isinstance(v, dict) and isinstance(v.get("delivery"),
+                                                      dict)}
+            with self._lock:
+                bare = self._sub_lines.get("")
+                self._sub_lines = sub_lines
+                if bare is not None:
+                    self._sub_lines[""] = bare
+                # Retirement sweep (doc/delivery.md): a job the service
+                # ACK no longer names is retired — release its cache
+                # reference so the digest's bytes evict once no other
+                # job shares them.
+                for job in [j for j in self._blob_cache
+                            if j and j not in jobs]:
+                    old = self._blob_cache.pop(job)
+                    self._release_digest_locked(old[1], "job_retired")
         t_recv = time.time()
         t_send = getattr(self, "_last_batch_send", None)
         server_ts = info.get("server_ts")

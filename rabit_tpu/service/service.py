@@ -282,6 +282,11 @@ class CollectiveService(Tracker):
             resume_from=resume,
             job=key, headless=True,
             **self._part_kwargs)
+        # ONE content-addressed snapshot store across every partition
+        # (doc/delivery.md): N tenants publishing identical bytes hold
+        # one copy, and the publish reply's "have" dedup bit is true no
+        # matter which job uploaded the digest first.
+        part._snaps = self._snaps
         with self._svc_lock:
             self._parts[key] = part
             if pooled:
@@ -337,10 +342,11 @@ class CollectiveService(Tracker):
 
     def _route_hello(self, task_id: str, cmd: int):
         route_id = task_id
-        if route_id.startswith("q#"):
-            # relay-batched quorum reports prefix the child's key
-            # (doc/scaling.md); route on the real id, reply under the
-            # prefixed one (the caller keeps the full route key).
+        if route_id.startswith(("q#", "s#")):
+            # relay-batched quorum reports (q#) and delivery RPCs (s#)
+            # prefix the child's key (doc/scaling.md, doc/delivery.md);
+            # route on the real id, reply under the prefixed one (the
+            # caller keeps the full route key).
             route_id = route_id[2:]
         job, rest = P.split_job(route_id)
         if cmd == P.CMD_OBS:
@@ -354,6 +360,19 @@ class CollectiveService(Tracker):
                 return (part if part is not None else self), \
                     (rest if part is not None else task_id)
             part = self.partition("") if rest == "#delta" else None
+            return (part if part is not None else self), task_id
+        if cmd in (P.CMD_SUB, P.CMD_SNAP):
+            # Delivery-plane routing (doc/delivery.md): a subscriber's
+            # poll or fetch reaches the job's partition when it is live
+            # and the service-level view otherwise — NEVER admission (a
+            # poll must not mint a job).  CMD_SNAP works either way: the
+            # digest store is service-shared (cross-job dedup), so a
+            # fetch for a retired job's digest still answers.
+            if job:
+                part = self.partition(job)
+                return (part if part is not None else self), \
+                    (rest if part is not None else task_id)
+            part = self.partition("")
             return (part if part is not None else self), task_id
         if job == P.POOL_PREFIX:
             # A pooled worker: CMD_SPARE (re-)parks it in the SERVICE
@@ -501,8 +520,17 @@ class CollectiveService(Tracker):
         per-job ``jobs`` map, so one relay answers CMD_EPOCH locally for
         every job behind it (doc/service.md)."""
         info = super()._batch_ack_info()
-        info["jobs"] = {key: part._epoch_info()
-                        for key, part in self._parts_items()}
+        jobs = {}
+        for key, part in self._parts_items():
+            jinfo = part._epoch_info()
+            with part._lock:
+                if part._delivery is not None:
+                    # the job's published version line rides the ACK so
+                    # the relay answers CMD_SUB polls locally
+                    # (doc/delivery.md)
+                    jinfo["delivery"] = dict(part._delivery)
+            jobs[key] = jinfo
+        info["jobs"] = jobs
         return info
 
     # -- live telemetry plane (doc/observability.md) -------------------------

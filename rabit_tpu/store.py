@@ -26,6 +26,8 @@ from pathlib import Path
 
 _GLOBAL_RE = re.compile(r"^global_r(\d+)_v(\d+)\.bin$")
 _KEEP = 2  # two-phase commit skews live ranks by at most one version
+# (the default; rabit_checkpoint_keep raises it — a deeper window for
+# slow consumers of the delivery plane, doc/delivery.md)
 # File layout: magic + crc32 + payload length, then the payload.  A file
 # that fails the check (torn by a crash the rename protocol could not
 # cover, or bit-rotted) reads as ABSENT, so resume degrades to an older
@@ -50,12 +52,25 @@ _HDR3 = struct.Struct("<4sBxxxIII")  # ..., crc, enc len, world epoch
 
 
 class CheckpointStore:
-    def __init__(self, directory: str, rank: int, codec: str = "zlib"):
+    def __init__(self, directory: str, rank: int, codec: str = "zlib",
+                 keep: int | None = None):
         from rabit_tpu.compress import get_codec
+        from rabit_tpu.config import Config
 
         self.dir = Path(directory)
         self.rank = rank
         self._codec = None if codec in ("", "identity") else get_codec(codec)
+        # Retention window (rabit_checkpoint_keep): versions beyond the
+        # newest ``keep`` prune after each successful commit — without
+        # it the store directory grows one file pair per commit forever.
+        if keep is None:
+            keep = Config().get_int("rabit_checkpoint_keep", _KEEP)
+        self._keep = max(int(keep), 1)
+        # Pinned versions survive pruning regardless of age: the
+        # delivery plane pins the latest PUBLISHED version so a
+        # subscriber's fetch-in-flight never loses its bytes to a
+        # concurrent commit (doc/delivery.md).
+        self._pinned: set[int] = set()
         self.dir.mkdir(parents=True, exist_ok=True)
         # One directory scan at startup seeds the version list (and sweeps
         # tmp leftovers of crashed saves); after that, save() maintains it
@@ -93,8 +108,21 @@ class CheckpointStore:
         if version not in self._versions:
             self._versions.append(version)
             self._versions.sort()
-        while len(self._versions) > _KEEP:
-            v = self._versions.pop(0)
+        self._prune()
+
+    def pin(self, version: int) -> None:
+        """Exempt ``version`` from pruning (and release every older
+        pin): the delivery plane pins the latest published version so a
+        fetch-in-flight never loses its bytes (doc/delivery.md)."""
+        self._pinned = {v for v in self._pinned if v > version}
+        self._pinned.add(version)
+        self._prune()
+
+    def _prune(self) -> None:
+        unpinned = [v for v in self._versions if v not in self._pinned]
+        while len(unpinned) > self._keep:
+            v = unpinned.pop(0)
+            self._versions.remove(v)
             for p in (self._gpath(v), self._lpath(v)):
                 p.unlink(missing_ok=True)
                 self._cache.pop(p, None)

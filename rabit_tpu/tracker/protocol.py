@@ -192,6 +192,7 @@ MAGIC_LINK = 0x7AB17003
 MAGIC_BLOB = 0x7AB17004
 MAGIC_SKIP = 0x7AB17005
 MAGIC_DELTA = 0x7AB17006
+MAGIC_SNAP = 0x7AB17007
 ACK = 0
 
 CMD_START = 1
@@ -224,6 +225,23 @@ CMD_JOURNAL = 13
 #: payload is one coalesced per-job metric-delta frame
 #: (:func:`put_delta_frame`) the tracker folds into its rollups.
 CMD_OBS = 14
+#: Model-delivery plane (rabit_tpu/delivery, doc/delivery.md).  The
+#: message field is a JSON doc: a reader's poll (usually ``{}``) is
+#: answered with ACK + the job's current published version line
+#: ``{"version": V, "epoch": E, "digest": D, "size": N}`` (version 0 =
+#: nothing published yet); a writer's ``{"publish": {...}}`` registers a
+#: freshly committed snapshot's line, journals ``snapshot_published``,
+#: and the reply's ``"have"`` flag tells the publisher whether the
+#: content-addressed bytes for that digest are already held (cross-job
+#: dedup: identical bytes upload once).
+CMD_SUB = 15
+#: Content-addressed snapshot fetch (rabit_tpu/delivery).  The message
+#: is ``{"digest": D, "off": O, "len": L}`` (off/len optional: whole
+#: blob); the reply is one :func:`put_snap_frame` — NOT an ACK — so the
+#: relay tree can cache and serve the bytes digest-keyed without
+#: consulting the root.  An unknown digest answers with an empty frame
+#: (digest "", total 0): absence is a retryable state, not an error.
+CMD_SNAP = 16
 
 #: put_route_frame flags bit 0: close the child connection after
 #: delivering this frame's payload (the tracker's "conn.close()" crossing
@@ -249,6 +267,10 @@ PARITY_EXEMPT = {
         "CMD_JOURNAL": "standby trackers tail the journal over a direct "
                        "socket, never through a worker relay "
                        "(doc/ha.md)",
+        "CMD_SNAP": "proxied straight through by the relay with "
+                    "digest-keyed caching: snapshot fetches are large "
+                    "and the relay serves repeat digests locally "
+                    "(doc/delivery.md)",
     },
 }
 
@@ -450,7 +472,7 @@ def send_hello(
     if cmd in (CMD_START, CMD_RECOVER, CMD_SPARE):
         out.append(put_u32(listen_port))
     elif cmd in (CMD_PRINT, CMD_METRICS, CMD_HEARTBEAT, CMD_EPOCH,
-                 CMD_QUORUM, CMD_OBS):
+                 CMD_QUORUM, CMD_OBS, CMD_SUB, CMD_SNAP):
         out.append(put_str(message))
     elif cmd == CMD_BLOB:
         out += [put_u32(blob_version), put_u32(len(blob)), blob]
@@ -644,6 +666,53 @@ def recv_blob_frame(sock) -> tuple[int, bytes]:
     return version, recv_exact(sock, n) if n else b""
 
 
+def put_snap_frame(digest: str, total: int, off: int,
+                   payload: bytes) -> bytes:
+    """Encode one CMD_SNAP reply (doc/delivery.md): MAGIC_SNAP, the
+    content digest the bytes hash to, the blob's TOTAL size, the chunk
+    offset, then the chunk itself.  A miss is ``("", 0, 0, b"")`` —
+    the digest is not (yet) held, the subscriber retries.  The same
+    bytes ride a direct socket, a relay route frame, and the relay's
+    digest-keyed cache."""
+    return b"".join([put_u32(MAGIC_SNAP), put_str(digest), put_u32(total),
+                     put_u32(off), put_u32(len(payload)), payload])
+
+
+def read_snap_frame(sock) -> tuple[str, int, int, bytes]:
+    """Read one snap frame off a blocking stream; returns ``(digest,
+    total, off, chunk)``.  Raises ValueError on a bad magic or an
+    oversized field and ConnectionError on EOF."""
+    magic = get_u32(sock)
+    if magic != MAGIC_SNAP:
+        raise ValueError(f"bad snap magic {magic:#x}")
+    digest = get_str(sock)
+    total = get_u32(sock)
+    off = get_u32(sock)
+    n = get_u32(sock)
+    if n > 1 << 30:
+        raise ValueError(f"oversized snap chunk ({n} bytes)")
+    return digest, total, off, recv_exact(sock, n) if n else b""
+
+
+def snap_frame_from_bytes(data: bytes) -> tuple[str, int, int, bytes]:
+    """Parse one COMPLETE snap frame held in memory (a relay route-frame
+    payload).  Raises ValueError on bad magic or a torn frame."""
+    if len(data) < 8:
+        raise ValueError(f"short snap frame ({len(data)} bytes)")
+    if _U32.unpack_from(data, 0)[0] != MAGIC_SNAP:
+        raise ValueError(f"bad snap magic {_U32.unpack_from(data, 0)[0]:#x}")
+    dn = _U32.unpack_from(data, 4)[0]
+    if len(data) < 8 + dn + 12:
+        raise ValueError(f"torn snap frame ({len(data)} bytes)")
+    digest = data[8:8 + dn].decode()
+    total = _U32.unpack_from(data, 8 + dn)[0]
+    off = _U32.unpack_from(data, 12 + dn)[0]
+    n = _U32.unpack_from(data, 16 + dn)[0]
+    if len(data) != 20 + dn + n:
+        raise ValueError(f"torn snap frame ({len(data)} of {20 + dn + n})")
+    return digest, total, off, data[20 + dn:]
+
+
 @dataclass
 class BatchMsg:
     """One relayed sub-message inside a CMD_BATCH envelope (see module
@@ -805,7 +874,7 @@ def hello_parser():
         listen_port = _U32.unpack((yield 4))[0]
         return Hello(cmd, prev_rank, task_id, listen_port=listen_port)
     if cmd in (CMD_PRINT, CMD_METRICS, CMD_HEARTBEAT, CMD_EPOCH,
-               CMD_QUORUM, CMD_OBS):
+               CMD_QUORUM, CMD_OBS, CMD_SUB, CMD_SNAP):
         n = _U32.unpack((yield 4))[0]
         if n > 64 << 20:
             raise ValueError(f"oversized message ({n} bytes)")
@@ -972,13 +1041,16 @@ def tracker_rpc(
                     sock.settimeout(reply_timeout if reply_timeout is not None
                                     else timeout)
                     return Assignment.recv(sock)
+                if cmd == CMD_SNAP:
+                    # binary reply: the snap frame IS the message, no ACK
+                    return read_snap_frame(sock)
                 ack = get_u32(sock)
                 if cmd in (CMD_METRICS, CMD_HEARTBEAT):
                     # timestamped reply (see module docstring): the stamp
                     # plus the local send/recv bracket is one clock sample
                     server_ts = float(get_str(sock))
                     return TimedAck(ack, server_ts, t_send, time.time())
-                if cmd in (CMD_EPOCH, CMD_QUORUM, CMD_OBS):
+                if cmd in (CMD_EPOCH, CMD_QUORUM, CMD_OBS, CMD_SUB):
                     import json as _json
 
                     return _json.loads(get_str(sock))

@@ -92,6 +92,7 @@ events).
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 import queue
@@ -509,6 +510,17 @@ class Tracker:
         self._wave_started: float | None = None  # monotonic, first check-in
         self._spares: list[_Pending] = []  # parked hot spares (warm sockets)
         self._blob: tuple[int, bytes] | None = None  # (version, compressed)
+        # Model-delivery plane (rabit_tpu.delivery, doc/delivery.md):
+        # the published version line of this partition's model stream
+        # (version/epoch/digest/size of the newest snapshot) and the
+        # digest-keyed content-addressed snapshot store.  A
+        # CollectiveService aliases ONE store dict into every partition
+        # (cross-job dedup: N tenants publishing identical bytes hold —
+        # and ship — one copy).
+        self._delivery: dict | None = None
+        self._snaps: dict[str, bytes] = {}
+        self._sub_ids: set[str] = set()  # distinct subscriber task ids
+        self._fetched_digests: set[str] = set()  # first-fetch evidence
         self._ranks: dict[str, int] = {}  # task_id -> stable rank
         self._n_shutdown = 0
         self._shutdown_tasks: set[str] = set()
@@ -607,6 +619,15 @@ class Tracker:
         # the version, via spare_park records): rank 0 re-ships the blob
         # after its next commit, and a pre-failover spare already holds
         # its copy.
+        # The delivery VERSION LINE survives the failover
+        # (snapshot_published records replay into st.delivery); the
+        # snapshot bytes are likewise not journaled — relays keep their
+        # digest-keyed copies and the publisher re-pushes on its next
+        # commit, so a direct fetch of a not-yet-restored digest reads
+        # as an empty frame the subscriber retries past
+        # (doc/delivery.md).
+        if getattr(st, "delivery", None):
+            self._delivery = dict(st.delivery)
 
     def _drop_lease_locked(self, task_id: str) -> None:
         """Drop a lease (re-check-in, shutdown, park) and journal the
@@ -854,10 +875,17 @@ class Tracker:
             return (P.put_u32(P.ACK)
                     + P.put_str(json.dumps(self._epoch_info()))), None
         if h.cmd == P.CMD_BLOB:
+            # Content-addressing (doc/delivery.md): the digest is
+            # computed HERE from the received payload, so the snapshot
+            # store is self-certifying — an uploader cannot register
+            # bytes under a digest that does not match them, and two
+            # jobs uploading identical bytes land on one entry.
+            digest = hashlib.sha256(h.blob).hexdigest()
             with self._lock:
                 if self._blob is None or h.blob_version >= self._blob[0]:
                     self._blob = (h.blob_version, h.blob)
                     self._journal("blob", version=h.blob_version)
+                self._snaps[digest] = h.blob
                 self.events.append({
                     "ts": round(time.time(), 6),
                     "kind": "bootstrap_blob", "task_id": h.task_id,
@@ -910,6 +938,14 @@ class Tracker:
                         "task_id": h.task_id,
                     })
             return P.put_u32(P.ACK) + P.put_str(json.dumps(doc)), None
+        if h.cmd == P.CMD_SUB:
+            # Model-delivery version-line RPC (doc/delivery.md): dict
+            # math over live state only — reactor-safe.
+            return self._sub_reply(h.task_id, h.message), None
+        if h.cmd == P.CMD_SNAP:
+            # Snapshot chunk fetch: the reply IS a snap frame (no ACK
+            # prefix) — a byte slice of an in-memory blob, reactor-safe.
+            return self._snap_reply(h.task_id, h.message), None
         raise ValueError(f"unknown tracker cmd {h.cmd}")
 
     def _epoch_info(self) -> dict:
@@ -922,6 +958,87 @@ class Tracker:
                     "world": self.world_size,
                     "rewave": (self.elastic.grow_wanted(len(self._spares))
                                or self._repair_wanted)}
+
+    def _sub_reply(self, task_id: str, message: str) -> bytes:
+        """Serve one CMD_SUB delivery RPC (doc/delivery.md).  A reader
+        poll (``{}``) answers the current published version line; a
+        writer ``publish`` registers a new line, journals it
+        (``snapshot_published`` — a standby restores the line from the
+        replay) and reports whether the digest's bytes are already held,
+        so the publisher skips the upload when another tenant shipped
+        identical bytes first.  Shared verbatim by the threaded path,
+        the reactor, and the relay batch fold — identical wire bytes and
+        journal side effects on all three (serving-parity)."""
+        try:
+            req = json.loads(message) if message else {}
+        except ValueError:
+            req = {}
+        if not isinstance(req, dict):
+            req = {}
+        pub = req.get("publish")
+        if isinstance(pub, dict):
+            line = {"version": int(pub.get("version", 0)),
+                    "epoch": int(pub.get("epoch", 0)),
+                    "digest": str(pub.get("digest", "")),
+                    "size": int(pub.get("size", 0))}
+            with self._lock:
+                prev = self._delivery
+                if prev is None or line["version"] >= prev["version"]:
+                    self._delivery = line
+                    self._journal("snapshot_published", **line)
+                    self.events.append({
+                        "ts": round(time.time(), 6),
+                        "kind": "snapshot_published",
+                        "task_id": task_id, **line,
+                    })
+                reply = dict(self._delivery)
+                reply["have"] = line["digest"] in self._snaps
+            return P.put_u32(P.ACK) + P.put_str(json.dumps(reply))
+        with self._lock:
+            line = (dict(self._delivery) if self._delivery is not None
+                    else {"version": 0, "epoch": 0, "digest": "", "size": 0})
+            new_sub = task_id not in self._sub_ids
+            if new_sub:
+                self._sub_ids.add(task_id)
+        if new_sub:
+            obs_stream.stream_count("delivery_subscribers", 1, job=self.job)
+        return P.put_u32(P.ACK) + P.put_str(json.dumps(line))
+
+    def _snap_reply(self, task_id: str, message: str) -> bytes:
+        """Serve one CMD_SNAP chunk fetch: the reply is one snap frame —
+        the frame IS the message, no ACK prefix.  An UNKNOWN digest
+        answers an empty frame, not an error: the publisher registers
+        the version line before its bytes finish landing, and a freshly
+        promoted standby restores the line before anyone re-pushes the
+        bytes — absence is a retryable race, never a subscriber fault
+        (doc/delivery.md)."""
+        try:
+            req = json.loads(message) if message else {}
+        except ValueError:
+            req = {}
+        if not isinstance(req, dict):
+            req = {}
+        digest = str(req.get("digest", ""))
+        with self._lock:
+            blob = self._snaps.get(digest)
+        if blob is None:
+            return P.put_snap_frame("", 0, 0, b"")
+        off = max(int(req.get("off", 0)), 0)
+        ln = int(req.get("len", 0) or 0)
+        chunk = blob[off:off + ln] if ln > 0 else blob[off:]
+        with self._lock:
+            if digest not in self._fetched_digests:
+                # First-fetch evidence per digest — a 10^5-subscriber
+                # swarm must not flood the event timeline.
+                self._fetched_digests.add(digest)
+                self.events.append({
+                    "ts": round(time.time(), 6), "kind": "snapshot_fetched",
+                    "task_id": task_id, "digest": digest,
+                    "nbytes": len(blob),
+                })
+        obs_stream.stream_count("delivery_bytes_served", len(chunk),
+                                job=self.job, digest=digest)
+        return P.put_snap_frame(digest, len(blob), off, chunk)
 
     def _route_hello(self, task_id: str,
                      cmd: int) -> "tuple[Tracker | None, str]":
@@ -1331,6 +1448,13 @@ class Tracker:
         (doc/service.md)."""
         info = {"server_ts": round(time.time(), 6)}
         info.update(self._epoch_info())
+        with self._lock:
+            if self._delivery is not None:
+                # The published version line rides every batch ACK so a
+                # relay answers its children's CMD_SUB polls locally —
+                # root accepts stay O(relays) under a 10^5-subscriber
+                # swarm (doc/delivery.md).
+                info["delivery"] = dict(self._delivery)
         return info
 
     def _fold_batch_msg(self, channel: _RelayChannel,
@@ -1388,6 +1512,14 @@ class Tracker:
                 # into the live rollup, no reply (fire-and-forget, like
                 # the heartbeat/metrics it piggybacks on).
                 tr._fold_delta_frame(m.payload, ts)
+            elif m.cmd == P.CMD_SUB:
+                # A relayed delivery poll/publish the relay could not
+                # answer from its ack-refreshed cache (doc/delivery.md):
+                # the reply bytes are exactly the direct path's
+                # (_sub_reply is shared by all three serving paths), and
+                # they route back to the child parked at the relay.
+                channel.send_route(m.task_id, P.ROUTE_CLOSE,
+                                   tr._sub_reply(tid, m.payload.decode()))
             elif m.cmd == P.CMD_HANGUP:
                 # The relay saw a parked child's connection EOF: make its
                 # virtual connection read as hung up so the wave purge
@@ -1397,9 +1529,11 @@ class Tracker:
                 if vconn is not None:
                     vconn.child_dead = True
             # CMD_EPOCH never rides a batch (the relay answers polls from
-            # its ack-refreshed cache); CMD_BLOB is proxied straight
-            # through by the relay (rank-0 blob uploads are large and
-            # rare — they keep the synchronous path).
+            # its ack-refreshed cache); CMD_BLOB and CMD_SNAP are proxied
+            # straight through by the relay (blob uploads and snapshot
+            # fetches are large — they keep the synchronous path, and the
+            # relay serves repeat CMD_SNAP digests from its own
+            # digest-keyed cache without touching the root).
         except (ValueError, UnicodeDecodeError):
             pass  # one malformed sub-message must not hurt the batch
         return ts
@@ -2095,6 +2229,17 @@ class Tracker:
                 "n_events": len(self.events),
                 "n_snapshots": len(self.snapshots),
                 "messages_dropped": self.messages_dropped,
+                # Model-delivery plane (doc/delivery.md): the published
+                # version line, the digest store's footprint, and the
+                # distinct-subscriber count the autoscaler watches.
+                "delivery": {
+                    "line": (dict(self._delivery)
+                             if self._delivery is not None else None),
+                    "snaps": len(self._snaps),
+                    "snap_bytes": sum(len(b)
+                                      for b in self._snaps.values()),
+                    "subscribers": len(self._sub_ids),
+                },
             }
         # The rollup carries its own leaf lock; render it OUTSIDE
         # self._lock (lock-order discipline, doc/static_analysis.md).

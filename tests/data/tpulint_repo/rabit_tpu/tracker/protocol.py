@@ -7,6 +7,7 @@ CMD_PING = 7  # SEEDED: wire-cmd-unhandled (no tracker branch)
 CMD_WAVE = 20  # SEEDED: parity-cmd-unserved (threaded-only, not exempt)
 CMD_HALT = 21
 CMD_GHOST = 22
+CMD_SUB = 23  # SEEDED-SUB: parity-cmd-unserved (threaded-only, not exempt)
 
 #: serving-path asymmetry ledger (see the real protocol.py) — the
 #: reactor DOES serve CMD_HALT, so this entry is the stale-exempt seed.
@@ -25,3 +26,7 @@ def pack_hdr(a, b):
 
 def put_orphan_frame(version):  # SEEDED: wire-frame-oneway
     return _HDR.pack(version, 0)  # encoder with no recv_/read_ decoder
+
+
+def put_snap_frame(digest, total):  # SEEDED-SNAP: wire-frame-oneway
+    return _HDR.pack(total, len(digest))  # snapshot encoder, decoder missing

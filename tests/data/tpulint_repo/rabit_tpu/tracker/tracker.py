@@ -12,6 +12,7 @@ from rabit_tpu.tracker.protocol import (
     CMD_GHOST,
     CMD_HALT,
     CMD_START,
+    CMD_SUB,
     CMD_WAVE,
 )
 
@@ -100,7 +101,8 @@ class Tracker:
     command set.  CMD_START is served (identically) at all three;
     CMD_WAVE only at the threaded path with no exemption; CMD_HALT at
     all three but the reactor arm skips the journal append the other
-    two make."""
+    two make; CMD_SUB threaded-only too (the delivery-plane seed),
+    journaling a kind no ControlState apply folds."""
 
     def _journal(self, kind, **fields):
         return (kind, fields)
@@ -118,6 +120,9 @@ class Tracker:
         if cmd == CMD_HALT:
             self._journal("halt")
             return "halt"
+        if cmd == CMD_SUB:
+            self._journal("snapshot_published")  # SEEDED-SUB: journal-kind-unapplied
+            return "sub"
         return None
 
     # -- shared-reactor read callback --------------------------------------

@@ -128,6 +128,38 @@ def test_fixture_obs_handler_blocking_flagged():
     assert any(pat.match(l) for l in proc.stdout.splitlines()), proc.stdout
 
 
+def test_fixture_delivery_seeds_flagged():
+    """ISSUE 20's delivery-plane seeds: a CMD_SUB constant served at only
+    the threaded path (parity-cmd-unserved, once per missing path), a
+    snap-frame encoder with no read_/recv_ decoder (wire-frame-oneway),
+    and a snapshot_published journal append no ControlState apply folds
+    (journal-kind-unapplied).  Distinct SEEDED-SUB/SEEDED-SNAP markers:
+    ``seeded_line()`` returns only the first plain-SEEDED marker."""
+    proc = run_tpulint("--root", str(FIXTURE))
+    for marker, rule, relpath in [
+        ("SEEDED-SUB: parity-cmd-unserved", "parity-cmd-unserved",
+         "rabit_tpu/tracker/protocol.py"),
+        ("SEEDED-SNAP: wire-frame-oneway", "wire-frame-oneway",
+         "rabit_tpu/tracker/protocol.py"),
+        ("SEEDED-SUB: journal-kind-unapplied", "journal-kind-unapplied",
+         "rabit_tpu/tracker/tracker.py"),
+    ]:
+        line = next(
+            i for i, l in enumerate(
+                (FIXTURE / relpath).read_text().splitlines(), 1)
+            if marker in l)
+        pat = re.compile(
+            rf"^{re.escape(relpath)}:{line}: \[{re.escape(rule)}\]")
+        assert any(pat.match(l) for l in proc.stdout.splitlines()), (
+            f"expected {rule} at {relpath}:{line}: got\n{proc.stdout}")
+    # the unserved closure names BOTH missing paths for CMD_SUB
+    unserved = [l for l in proc.stdout.splitlines()
+                if "[parity-cmd-unserved]" in l and "CMD_SUB" in l]
+    assert len(unserved) == 2, unserved
+    assert any("reactor" in l for l in unserved), unserved
+    assert any("relay-fold" in l for l in unserved), unserved
+
+
 def test_fixture_native_only_constant_flagged():
     """A native kCmd with no Python counterpart is a mismatch finding
     anchored in comm.h."""
@@ -500,8 +532,9 @@ def test_json_reports_per_family_counts(tmp_path):
         assert name in fam, sorted(fam)
         assert set(fam[name]) == {"findings", "new", "seconds"}
     assert fam["determinism"]["new"] == 3
-    # unserved x2 (reactor + relay-fold), stale, diverge, route-dead
-    assert fam["serving-parity"]["new"] == 5
+    # unserved x2 each for CMD_WAVE and CMD_SUB (reactor + relay-fold),
+    # stale, diverge, route-dead
+    assert fam["serving-parity"]["new"] == 7
     assert fam["resources"]["new"] == 3
     assert sum(f["new"] for f in fam.values()) == doc["counts"]["new"]
     assert re.search(r"tpulint: timing: determinism\s+\d+\.\d+s",
@@ -524,14 +557,20 @@ def test_real_tree_parity_coverage_table():
     graph = CallGraph.build(files, REPO)
     cov = servingparity.path_coverage(graph)
     assert set(cov) == {"threaded", "reactor", "relay-fold"}
-    for cmd in ("CMD_OBS", "CMD_QUORUM"):
+    for cmd in ("CMD_OBS", "CMD_QUORUM", "CMD_SUB"):
         for path in cov:
             assert cmd in cov[path], (cmd, path, sorted(cov[path]))
     assert "CMD_JOURNAL" in cov["threaded"]
     assert "CMD_JOURNAL" in cov["reactor"]
     assert "CMD_JOURNAL" not in cov["relay-fold"]
+    # delivery fetches (CMD_SNAP) are proxied, not folded, by the relay —
+    # served at the two direct paths with the asymmetry declared
+    assert "CMD_SNAP" in cov["threaded"]
+    assert "CMD_SNAP" in cov["reactor"]
+    assert "CMD_SNAP" not in cov["relay-fold"]
     exempt = servingparity.load_exemptions(
         REPO / "rabit_tpu" / "tracker" / "protocol.py")
     assert "CMD_JOURNAL" in exempt["relay-fold"]
+    assert "CMD_SNAP" in exempt["relay-fold"]
     # and the family as a whole signs off on the real tree
     assert servingparity.check_parity(graph, REPO) == []
